@@ -1,0 +1,72 @@
+"""Metrics/meters tests (reference behavior: unicore/logging/)."""
+
+import pytest
+
+from unicore_tpu.logging import meters, metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def test_average_meter_weighted():
+    m = meters.AverageMeter()
+    m.update(1.0, 1)
+    m.update(3.0, 3)
+    assert m.avg == pytest.approx(2.5)
+    assert m.val == 3.0
+
+
+def test_nested_aggregation():
+    with metrics.aggregate("train") as outer:
+        metrics.log_scalar("loss", 1.0)
+        with metrics.aggregate() as inner:
+            metrics.log_scalar("loss", 3.0)
+    # outer saw both, inner only the second
+    assert outer.get_smoothed_value("loss") == pytest.approx(2.0)
+    assert inner.get_smoothed_value("loss") == pytest.approx(3.0)
+
+
+def test_new_root_isolation():
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", 1.0)
+        with metrics.aggregate("valid", new_root=True):
+            metrics.log_scalar("loss", 9.0)
+        metrics.log_scalar("loss", 3.0)
+    assert metrics.get_smoothed_value("train", "loss") == pytest.approx(2.0)
+    assert metrics.get_smoothed_value("valid", "loss") == pytest.approx(9.0)
+
+
+def test_derived_meter():
+    with metrics.aggregate("train"):
+        metrics.log_scalar("a", 4.0)
+        metrics.log_derived("b", lambda m: m["a"].avg * 2)
+    assert metrics.get_smoothed_value("train", "b") == pytest.approx(8.0)
+
+
+def test_state_dict_roundtrip():
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", 2.5, weight=4)
+    state = metrics.state_dict()
+    metrics.reset()
+    metrics.load_state_dict(state)
+    assert metrics.get_smoothed_value("train", "loss") == pytest.approx(2.5)
+
+
+def test_meters_dict_priority_order():
+    md = meters.MetersDict()
+    md.add_meter("z", meters.AverageMeter(), priority=10)
+    md.add_meter("a", meters.AverageMeter(), priority=50)
+    md.add_meter("m", meters.AverageMeter(), priority=20)
+    assert list(md.keys()) == ["z", "m", "a"]
+
+
+def test_jax_scalar_coercion():
+    import jax.numpy as jnp
+
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", jnp.float32(2.0), weight=jnp.int32(2))
+    assert metrics.get_smoothed_value("train", "loss") == pytest.approx(2.0)

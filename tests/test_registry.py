@@ -1,0 +1,68 @@
+"""Registry + options tests (reference behavior: unicore/registry.py,
+unicore/options.py two-pass parsing)."""
+
+import argparse
+
+import pytest
+
+from unicore_tpu.registry import REGISTRIES, setup_registry
+
+
+def test_setup_registry_and_build():
+    class Base:
+        def __init__(self, args):
+            self.args = args
+
+    build, register, registry = setup_registry("--test-thing", base_class=Base, default="a")
+
+    @register("a")
+    class A(Base):
+        pass
+
+    @register("b")
+    class B(Base):
+        @classmethod
+        def build_test_thing(cls, args):
+            return "custom-built"
+
+    assert registry == {"a": A, "b": B}
+
+    args = argparse.Namespace(test_thing="a")
+    assert isinstance(build(args), A)
+    args = argparse.Namespace(test_thing="b")
+    assert build(args) == "custom-built"
+
+    with pytest.raises(ValueError):
+        register("a")(A)
+
+    class NotBase:
+        pass
+
+    with pytest.raises(ValueError):
+        register("c")(NotBase)
+
+    del REGISTRIES["test_thing"]
+
+
+def test_registries_populated():
+    # importing the package must register the built-in components
+    import unicore_tpu  # noqa
+
+    assert "loss" in REGISTRIES
+    assert "optimizer" in REGISTRIES
+    assert "lr_scheduler" in REGISTRIES
+
+
+def test_set_defaults():
+    from unicore_tpu.registry import set_defaults
+
+    class Thing:
+        @classmethod
+        def add_args(cls, parser):
+            parser.add_argument("--thing-alpha", type=float, default=0.5)
+            parser.add_argument("--thing-beta", type=int, default=3)
+
+    args = argparse.Namespace(thing_alpha=1.0)
+    set_defaults(args, Thing)
+    assert args.thing_alpha == 1.0  # explicit value preserved
+    assert args.thing_beta == 3  # default harvested

@@ -1,0 +1,20 @@
+"""unicore_tpu: a TPU-native distributed training framework.
+
+Brand-new jax/XLA/Pallas implementation of the capability surface of
+Uni-Core (an efficient distributed PyTorch trainer; see SURVEY.md at the
+repo root for the full structural analysis of the reference).  Registries,
+CLI, data pipeline, and checkpoint semantics match the reference; the
+execution model is single-program SPMD: one jit-compiled train step sharded
+over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+# Keep the top-level import light: data/losses/optim/tasks are torch- and
+# jax-free at import time, so preprocessing boxes don't pay jax init cost.
+# `unicore_tpu.models` / `unicore_tpu.modules` import jax+flax and are pulled
+# in lazily by options.parse_args_and_arch / the CLI.
+import unicore_tpu.data  # noqa
+import unicore_tpu.losses  # noqa
+import unicore_tpu.optim  # noqa
+import unicore_tpu.tasks  # noqa

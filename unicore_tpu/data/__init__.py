@@ -1,0 +1,66 @@
+"""Composable data pipeline (reference: unicore/data/__init__.py).
+
+Import order matters: base classes first, then wrappers.
+"""
+
+from .unicore_dataset import UnicoreDataset, EpochListening  # noqa isort:skip
+from .base_wrapper_dataset import BaseWrapperDataset  # noqa isort:skip
+
+from . import data_utils, iterators  # noqa
+from .bert_tokenize_dataset import BertTokenizeDataset  # noqa
+from .dictionary import Dictionary  # noqa
+from .indexed_dataset import (  # noqa
+    IndexedRecordDataset,
+    IndexedRecordWriter,
+    best_record_dataset,
+)
+from .lmdb_dataset import LMDBDataset  # noqa
+from .mask_tokens_dataset import MaskTokensDataset  # noqa
+from .misc_datasets import LRUCacheDataset, NumelDataset, NumSamplesDataset  # noqa
+from .nested_dictionary_dataset import NestedDictionaryDataset  # noqa
+from .pad_dataset import (  # noqa
+    LeftPadDataset,
+    PadDataset,
+    RightPadDataset,
+    RightPadDataset2D,
+)
+from .sort_dataset import EpochShuffleDataset, SortDataset  # noqa
+from .token_datasets import (  # noqa
+    AppendTokenDataset,
+    FromNumpyDataset,
+    PrependTokenDataset,
+    RawArrayDataset,
+    RawLabelDataset,
+    RawNumpyDataset,
+    TokenizeDataset,
+)
+
+__all__ = [
+    "AppendTokenDataset",
+    "BaseWrapperDataset",
+    "BertTokenizeDataset",
+    "Dictionary",
+    "EpochListening",
+    "EpochShuffleDataset",
+    "FromNumpyDataset",
+    "IndexedRecordDataset",
+    "IndexedRecordWriter",
+    "LeftPadDataset",
+    "LMDBDataset",
+    "LRUCacheDataset",
+    "MaskTokensDataset",
+    "NestedDictionaryDataset",
+    "NumelDataset",
+    "NumSamplesDataset",
+    "PadDataset",
+    "PrependTokenDataset",
+    "RawArrayDataset",
+    "RawLabelDataset",
+    "RawNumpyDataset",
+    "RightPadDataset",
+    "RightPadDataset2D",
+    "SortDataset",
+    "TokenizeDataset",
+    "UnicoreDataset",
+    "best_record_dataset",
+]

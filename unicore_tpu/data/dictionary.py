@@ -1,0 +1,166 @@
+"""Symbol <-> index mapping (reference: unicore/data/dictionary.py:12-148).
+
+Same defaults as the reference: ``[CLS]/[PAD]/[SEP]/[UNK]`` specials, text
+file loading with ``#overwrite`` dedup control, and a vectorized
+``vec_index`` for whole-array token lookup.
+"""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class Dictionary:
+    """A mapping from symbols to consecutive integers."""
+
+    def __init__(
+        self,
+        *,
+        bos="[CLS]",
+        pad="[PAD]",
+        eos="[SEP]",
+        unk="[UNK]",
+        extra_special_symbols=None,
+    ):
+        self.bos_word, self.unk_word, self.pad_word, self.eos_word = bos, unk, pad, eos
+        self.symbols = []
+        self.count = []
+        self.indices = {}
+        self.specials = set()
+        self.bos_index = self.add_symbol(bos, is_special=True)
+        self.pad_index = self.add_symbol(pad, is_special=True)
+        self.eos_index = self.add_symbol(eos, is_special=True)
+        self.unk_index = self.add_symbol(unk, is_special=True)
+        if extra_special_symbols:
+            for s in extra_special_symbols:
+                self.add_symbol(s, is_special=True)
+
+    def __eq__(self, other):
+        return self.indices == other.indices
+
+    def __getitem__(self, idx):
+        if idx < len(self.symbols):
+            return self.symbols[idx]
+        return self.unk_word
+
+    def __len__(self):
+        """Returns the number of symbols in the dictionary."""
+        return len(self.symbols)
+
+    def __contains__(self, sym):
+        return sym in self.indices
+
+    def vec_index(self, a):
+        """Vectorized lookup of an array of symbols."""
+        return np.vectorize(self.index)(a)
+
+    def index(self, sym):
+        """Returns the index of the specified symbol."""
+        assert isinstance(sym, str)
+        if sym in self.indices:
+            return self.indices[sym]
+        if self.unk_word in self.indices:
+            return self.indices[self.unk_word]
+        raise KeyError(
+            f"symbol '{sym}' not in dictionary and no unk symbol is defined"
+        )
+
+    def special_index(self):
+        return [self.index(x) for x in self.specials]
+
+    def add_symbol(self, word, n=1, overwrite=False, is_special=False):
+        """Adds a word to the dictionary."""
+        if is_special:
+            self.specials.add(word)
+        if word in self.indices and not overwrite:
+            idx = self.indices[word]
+            self.count[idx] = self.count[idx] + n
+            return idx
+        else:
+            idx = len(self.symbols)
+            self.indices[word] = idx
+            self.symbols.append(word)
+            self.count.append(n)
+            return idx
+
+    def bos(self):
+        """Helper to get index of beginning-of-sentence symbol"""
+        return self.index(self.bos_word)
+
+    def pad(self):
+        """Helper to get index of pad symbol"""
+        return self.index(self.pad_word)
+
+    def eos(self):
+        """Helper to get index of end-of-sentence symbol"""
+        return self.index(self.eos_word)
+
+    def unk(self):
+        """Helper to get index of unk symbol"""
+        return self.index(self.unk_word)
+
+    @classmethod
+    def load(cls, f):
+        """Loads the dictionary from a text file with the format:
+
+        ```
+        <symbol0> <count0>
+        <symbol1> <count1>
+        ...
+        ```
+        """
+        d = cls()
+        d.add_from_file(f)
+        return d
+
+    def add_from_file(self, f):
+        """Loads a pre-existing dictionary from a text file and adds its
+        symbols to this instance."""
+        if isinstance(f, str):
+            try:
+                with open(f, "r", encoding="utf-8") as fd:
+                    self.add_from_file(fd)
+            except FileNotFoundError as fnfe:
+                raise fnfe
+            except UnicodeError:
+                raise Exception(f"Incorrect encoding detected in {f}, please rebuild the dataset")
+            return
+
+        lines = f.readlines()
+
+        for line_idx, line in enumerate(lines):
+            try:
+                splits = line.rstrip().rsplit(" ", 1)
+                line = splits[0]
+                field = splits[1] if len(splits) > 1 else str(len(lines) - line_idx)
+                if field == "#overwrite":
+                    overwrite = True
+                    line, field = line.rsplit(" ", 1)
+                else:
+                    overwrite = False
+                count = int(field)
+                word = line
+                if word in self and not overwrite:
+                    logger.info(
+                        f"Duplicate word found when loading Dictionary: '{word}', "
+                        "skipping (add the #overwrite flag at the end of the row "
+                        "to replace the earlier entry)"
+                    )
+                else:
+                    self.add_symbol(word, n=count, overwrite=overwrite)
+            except ValueError:
+                raise ValueError(
+                    "Incorrect dictionary format, expected '<token> <cnt> [flags]'"
+                )
+
+    def save(self, f):
+        """Stores dictionary into a text file."""
+        if isinstance(f, str):
+            with open(f, "w", encoding="utf-8") as fd:
+                return self.save(fd)
+        defaults = {self.bos_word, self.pad_word, self.eos_word, self.unk_word}
+        for symbol, count in zip(self.symbols, self.count):
+            if symbol not in defaults:  # constructor re-creates the defaults
+                print(f"{symbol} {count}", file=f)

@@ -1,0 +1,85 @@
+"""Native single-file record store (no external dependencies).
+
+Replaces LMDB when the ``lmdb`` package is unavailable: a ``.rec`` data file
+of concatenated pickled records plus a ``.rec.idx`` numpy offset table.
+Records are arbitrary picklable objects (typically dicts of numpy arrays),
+matching the reference's LMDB record semantics
+(``unicore/data/lmdb_dataset.py:47-50``). Reads are mmap-backed and
+thread-safe; the per-item LRU cache mirrors the reference.
+"""
+
+import os
+import pickle
+from functools import lru_cache
+
+import numpy as np
+
+from .unicore_dataset import UnicoreDataset
+
+_MAGIC = b"UTPUREC1"
+
+
+class IndexedRecordWriter:
+    """Streaming writer: ``with IndexedRecordWriter(path) as w: w.write(obj)``."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = open(path, "wb")
+        self._f.write(_MAGIC)
+        self._offsets = [self._f.tell()]
+
+    def write(self, obj):
+        self._f.write(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        self._offsets.append(self._f.tell())
+
+    def close(self):
+        self._f.close()
+        np.asarray(self._offsets, dtype=np.int64).tofile(self.path + ".idx")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class IndexedRecordDataset(UnicoreDataset):
+    """Reads records written by :class:`IndexedRecordWriter`."""
+
+    def __init__(self, path):
+        self.path = path
+        assert os.path.isfile(path), f"{path} not found"
+        assert os.path.isfile(path + ".idx"), f"{path}.idx not found"
+        self._offsets = np.fromfile(path + ".idx", dtype=np.int64)
+        with open(path, "rb") as f:
+            assert f.read(len(_MAGIC)) == _MAGIC, f"{path}: bad magic"
+        self._mmap = None
+
+    def _data(self):
+        if self._mmap is None:
+            self._mmap = np.memmap(self.path, dtype=np.uint8, mode="r")
+        return self._mmap
+
+    def __len__(self):
+        return len(self._offsets) - 1
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, idx):
+        start, end = self._offsets[idx], self._offsets[idx + 1]
+        return pickle.loads(self._data()[start:end].tobytes())
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_mmap"] = None  # re-open after fork/pickle
+        return state
+
+
+def best_record_dataset(path):
+    """Open *path* with whichever backend matches: ``.rec`` native store or
+    LMDB file."""
+    if path.endswith(".rec") or os.path.isfile(path + ".idx"):
+        return IndexedRecordDataset(path)
+    from .lmdb_dataset import LMDBDataset
+
+    return LMDBDataset(path)

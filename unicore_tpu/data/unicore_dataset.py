@@ -1,0 +1,98 @@
+"""Dataset base class (reference: unicore/data/unicore_dataset.py:35-91).
+
+Torch-free: a dataset is a map-style container of numpy-backed samples with a
+``collater`` that builds the padded batch dict the jitted step consumes.
+"""
+
+import numpy as np
+
+
+class EpochListening:
+    """Mixin for receiving updates whenever the epoch increments."""
+
+    @property
+    def can_reuse_epoch_itr_across_epochs(self):
+        """Whether the EpochBatchIterator can be cached across epochs.
+
+        Only safe when the dataset is immune to ``set_epoch`` (no epoch-
+        dependent masking/shuffling below it).
+        """
+        return False
+
+    def set_epoch(self, epoch):
+        """Will receive the updated epoch number at the beginning of the epoch."""
+        pass
+
+
+class UnicoreDataset(EpochListening):
+    """A dataset that provides helpers for batching."""
+
+    def __getitem__(self, index):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def collater(self, samples):
+        """Merge a list of samples to form a mini-batch.
+
+        Args:
+            samples (List[dict]): samples to collate
+
+        Returns:
+            dict: a mini-batch suitable for the jitted step
+        """
+        raise NotImplementedError
+
+    def num_tokens(self, index: int) -> int:
+        """Number of tokens in a sample (used for length-based ordering)."""
+        raise NotImplementedError
+
+    def size(self, index: int):
+        """Size of a sample (used for filtering / bucketing)."""
+        raise NotImplementedError
+
+    def ordered_indices(self):
+        """Ordered list of indices; batches are drawn in this order."""
+        return np.arange(len(self), dtype=np.int64)
+
+    @property
+    def supports_prefetch(self):
+        """Whether this dataset supports prefetching."""
+        return False
+
+    def attr(self, attr: str, index: int):
+        return getattr(self, attr, None)
+
+    def prefetch(self, indices):
+        """Prefetch the data required for this epoch."""
+        raise NotImplementedError
+
+    def batch_by_size(
+        self,
+        indices,
+        batch_size=None,
+        required_batch_size_multiple=1,
+    ):
+        """Chunk the ordered indices into fixed-size batches
+        (reference unicore_dataset.py:67 -> data_utils.batch_by_size)."""
+        from unicore_tpu.data import data_utils
+
+        return data_utils.batch_by_size(
+            indices,
+            batch_size=batch_size,
+            required_batch_size_multiple=required_batch_size_multiple,
+        )
+
+    def filter_indices_by_size(self, indices, max_sizes):
+        """Filter a list of sample indices. Remove those that are longer than
+        specified in *max_sizes*. Returns (kept_indices, ignored_indices)."""
+        if max_sizes is None:
+            return indices, []
+        sizes = np.array([self.size(i) for i in indices])
+        if isinstance(max_sizes, (int, float)):
+            keep = sizes <= max_sizes
+        else:
+            keep = np.all(sizes <= np.asarray(max_sizes), axis=-1)
+        ignored = indices[~keep]
+        return indices[keep], ignored.tolist()

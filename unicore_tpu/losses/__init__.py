@@ -1,0 +1,25 @@
+"""Loss registry keyed by the ``--loss`` flag (reference:
+unicore/losses/__init__.py:17-23, default ``cross_entropy``)."""
+
+import importlib
+import os
+
+from unicore_tpu.registry import setup_registry
+
+from .unicore_loss import UnicoreLoss  # noqa: F401
+
+build_loss_, register_loss, LOSS_REGISTRY = setup_registry(
+    "--loss", base_class=UnicoreLoss, default="cross_entropy"
+)
+
+
+def build_loss(args, task):
+    return build_loss_(args, task)
+
+
+# auto-import sibling modules so @register_loss decorators run
+losses_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(losses_dir)):
+    path = os.path.join(losses_dir, file)
+    if not file.startswith("_") and file.endswith(".py") and os.path.isfile(path):
+        importlib.import_module("unicore_tpu.losses." + file[: file.find(".py")])

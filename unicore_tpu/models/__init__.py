@@ -1,0 +1,72 @@
+"""Model registry (reference: unicore/models/__init__.py).
+
+Three registries:
+- ``MODEL_REGISTRY``: model-name -> model class
+- ``ARCH_MODEL_REGISTRY``: architecture-name -> model class
+- ``ARCH_CONFIG_REGISTRY``: architecture-name -> args-mutator function
+"""
+
+import argparse
+import importlib
+import os
+
+from .unicore_model import (  # noqa: F401
+    BaseUnicoreModel,
+    UnicoreEncoderDecoderModel,
+    UnicoreEncoderModel,
+)
+
+MODEL_REGISTRY = {}
+ARCH_MODEL_REGISTRY = {}
+ARCH_MODEL_INV_REGISTRY = {}
+ARCH_CONFIG_REGISTRY = {}
+
+
+def build_model(args, task):
+    return ARCH_MODEL_REGISTRY[args.arch].build_model(args, task)
+
+
+def register_model(name):
+    """Decorator registering a :class:`BaseUnicoreModel` subclass."""
+
+    def register_model_cls(cls):
+        if name in MODEL_REGISTRY:
+            raise ValueError(f"Cannot register duplicate model ({name})")
+        if not issubclass(cls, BaseUnicoreModel):
+            raise ValueError(
+                f"Model ({name}: {cls.__name__}) must extend BaseUnicoreModel"
+            )
+        MODEL_REGISTRY[name] = cls
+        return cls
+
+    return register_model_cls
+
+
+def register_model_architecture(model_name, arch_name):
+    """Decorator registering an architecture preset: a function mutating the
+    parsed args namespace with architecture hyperparameter defaults."""
+
+    def register_model_arch_fn(fn):
+        if model_name not in MODEL_REGISTRY:
+            raise ValueError(
+                f"Cannot register model architecture for unknown model type ({model_name})"
+            )
+        if arch_name in ARCH_MODEL_REGISTRY:
+            raise ValueError(f"Cannot register duplicate model architecture ({arch_name})")
+        if not callable(fn):
+            raise ValueError(f"Model architecture must be callable ({arch_name})")
+        ARCH_MODEL_REGISTRY[arch_name] = MODEL_REGISTRY[model_name]
+        ARCH_MODEL_INV_REGISTRY.setdefault(model_name, []).append(arch_name)
+        ARCH_CONFIG_REGISTRY[arch_name] = fn
+        return fn
+
+    return register_model_arch_fn
+
+
+# auto-import any sibling modules so their @register_model decorators run
+models_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(models_dir)):
+    path = os.path.join(models_dir, file)
+    if not file.startswith("_") and file.endswith(".py") and os.path.isfile(path):
+        module_name = file[: file.find(".py")]
+        importlib.import_module("unicore_tpu.models." + module_name)

@@ -1,0 +1,64 @@
+"""Base classes for models.
+
+The reference's ``BaseUnicoreModel`` (``unicore/models/unicore_model.py:18``)
+is a ``torch.nn.Module`` with ``add_args``/``build_model`` classmethods.  The
+TPU-native equivalent is a **flax linen Module**: parameters live in an
+external pytree, the module itself is a pure function of (params, inputs),
+which is what lets the trainer jit one SPMD train step over a device mesh.
+"""
+
+import flax.linen as nn
+
+
+class BaseUnicoreModel(nn.Module):
+    """Base class for models.
+
+    Subclasses are flax modules: declare hyperparameters as dataclass fields,
+    implement ``__call__`` (or ``forward``-style methods) referencing
+    ``self.param``/submodules, and provide the two registry classmethods.
+    """
+
+    @classmethod
+    def add_args(cls, parser):
+        """Add model-specific arguments to the parser."""
+        pass
+
+    @classmethod
+    def build_model(cls, args, task):
+        """Build a new model instance from config + task."""
+        raise NotImplementedError("Model must implement the build_model method")
+
+    # -- parameter lifecycle --------------------------------------------------
+
+    def init_params(self, rng, sample):
+        """Initialize a parameter pytree from a dummy sample.
+
+        ``sample["net_input"]`` is splatted into the module, mirroring the
+        reference's calling convention (``unicore/losses/masked_lm.py:27``).
+        """
+        variables = self.init(rng, **sample["net_input"])
+        return variables["params"]
+
+    def get_targets(self, sample, net_output):
+        """Get targets from either the sample or the net's output."""
+        return sample["target"]
+
+    # -- stateful-API compatibility shims ------------------------------------
+
+    def set_num_updates(self, num_updates):
+        """No-op: step counts are threaded functionally through the loss
+        (reference mutates module state, unicore_model.py; jax models are
+        pure)."""
+        pass
+
+
+class UnicoreEncoderModel(BaseUnicoreModel):
+    """Base for single-encoder models (parity with unicore_model.py:50)."""
+
+    pass
+
+
+class UnicoreEncoderDecoderModel(BaseUnicoreModel):
+    """Base for encoder-decoder models."""
+
+    pass

@@ -1,0 +1,1 @@
+"""NN modules (flax) — populated incrementally."""

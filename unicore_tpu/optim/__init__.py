@@ -1,0 +1,27 @@
+"""Optimizer registry keyed by ``--optimizer`` (reference:
+unicore/optim/__init__.py:22-26, default ``adam``)."""
+
+import importlib
+import os
+
+from unicore_tpu.registry import setup_registry
+
+from .unicore_optimizer import UnicoreOptimizer  # noqa: F401
+
+build_optimizer_, register_optimizer, OPTIMIZER_REGISTRY = setup_registry(
+    "--optimizer", base_class=UnicoreOptimizer, default="adam", required=True
+)
+
+
+def build_optimizer(args, **kwargs):
+    return build_optimizer_(args, **kwargs)
+
+
+# auto-import sibling modules so @register_optimizer decorators run
+optim_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(optim_dir)):
+    path = os.path.join(optim_dir, file)
+    if not file.startswith("_") and file.endswith(".py") and os.path.isfile(path):
+        importlib.import_module("unicore_tpu.optim." + file[: file.find(".py")])
+
+from . import lr_scheduler  # noqa: E402,F401

@@ -1,0 +1,27 @@
+"""LR-scheduler registry keyed by ``--lr-scheduler`` (reference:
+unicore/optim/lr_scheduler/__init__.py:17-23, default ``fixed``)."""
+
+import importlib
+import os
+
+from unicore_tpu.registry import setup_registry
+
+from .unicore_lr_scheduler import UnicoreLRScheduler  # noqa: F401
+
+build_lr_scheduler_, register_lr_scheduler, LR_SCHEDULER_REGISTRY = setup_registry(
+    "--lr-scheduler", base_class=UnicoreLRScheduler, default="fixed"
+)
+
+
+def build_lr_scheduler(args, optimizer, total_train_steps):
+    return build_lr_scheduler_(args, optimizer, total_train_steps)
+
+
+# auto-import sibling modules so @register_lr_scheduler decorators run
+schedulers_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(schedulers_dir)):
+    path = os.path.join(schedulers_dir, file)
+    if not file.startswith("_") and file.endswith(".py") and os.path.isfile(path):
+        importlib.import_module(
+            "unicore_tpu.optim.lr_scheduler." + file[: file.find(".py")]
+        )

@@ -1,0 +1,58 @@
+"""LR scheduler base class (reference:
+unicore/optim/lr_scheduler/unicore_lr_scheduler.py:12-49).
+
+Schedulers run **host-side**: they compute a python float each update which
+the trainer feeds into the jitted step as a traced scalar.  This preserves
+the reference's stateful scheduler contract (``step_begin_epoch`` /
+``step(epoch, val_loss)`` / ``step_update(num_updates)``) — including
+val-loss-reactive schedules like reduce_lr_on_plateau — with zero
+recompilation cost.
+"""
+
+from argparse import Namespace
+
+
+class UnicoreLRScheduler:
+    def __init__(self, args: Namespace, optimizer, total_train_steps):
+        super().__init__()
+        self.args = args
+        self.optimizer = optimizer
+        self.total_train_steps = total_train_steps
+        self.best = None
+        self.lr = args.lr[0] if isinstance(args.lr, (list, tuple)) else args.lr
+
+    @classmethod
+    def add_args(cls, parser):
+        """Add scheduler-specific arguments to the parser."""
+        pass
+
+    def set_lr(self, lr):
+        self.lr = lr
+
+    def get_lr(self):
+        """Current learning rate (python float)."""
+        return self.lr
+
+    def state_dict(self):
+        return {"best": self.best, "lr": self.lr}
+
+    def load_state_dict(self, state_dict):
+        self.best = state_dict.get("best", None)
+        if "lr" in state_dict:
+            self.lr = state_dict["lr"]
+
+    def step_begin_epoch(self, epoch):
+        """Update the lr at the beginning of a new epoch."""
+        pass
+
+    def step(self, epoch, val_loss=None):
+        """Update the lr at the end of a given epoch."""
+        if val_loss is not None:
+            if self.best is None:
+                self.best = val_loss
+            else:
+                self.best = min(self.best, val_loss)
+
+    def step_update(self, num_updates):
+        """Update the lr after each optimizer update. Returns the new lr."""
+        return self.get_lr()

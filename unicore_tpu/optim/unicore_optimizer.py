@@ -1,0 +1,55 @@
+"""Optimizer base class.
+
+Reference: ``unicore/optim/unicore_optimizer.py:10`` — a wrapper over
+``torch.optim`` with lr get/set, grad manipulation, and step.  The TPU-native
+contract is functional (optax-style) so the whole update can be traced into
+the jitted train step::
+
+    state = opt.init(params)                       # fp32 state pytree
+    updates, state = opt.update(grads, state, params, lr=lr)
+    params = optax.apply_updates(params, updates)
+
+``lr`` is threaded per-step as a traced scalar (schedulers run host-side and
+feed the value in — no recompilation per step).  Gradient scaling / clipping
+/ accumulation live in the trainer, not here, mirroring the reference's
+split of responsibilities.
+"""
+
+from argparse import Namespace
+
+
+class UnicoreOptimizer:
+    def __init__(self, args: Namespace):
+        self.args = args
+
+    @classmethod
+    def add_args(cls, parser):
+        """Add optimizer-specific arguments to the parser."""
+        pass
+
+    @classmethod
+    def build_optimizer(cls, args, **kwargs):
+        return cls(args)
+
+    # -- functional interface (used inside jit) -------------------------------
+
+    def init(self, params):
+        """Create the optimizer state pytree for *params*."""
+        raise NotImplementedError
+
+    def update(self, grads, state, params, *, lr):
+        """One optimizer step. Returns ``(updates, new_state)`` where
+        ``updates`` are deltas to add to the params (optax convention)."""
+        raise NotImplementedError
+
+    # -- capability flags (reference unicore_optimizer.py:163-189) ------------
+
+    @property
+    def supports_flat_params(self):
+        """Whether the optimizer may operate on a flat 1-D param slab
+        (enables the fused Pallas update path)."""
+        return False
+
+    def state_static_args(self):
+        """Hashable knobs that affect the traced update (for jit cache)."""
+        return ()

@@ -1,0 +1,47 @@
+"""Task registry keyed by ``--task`` (reference: unicore/tasks/__init__.py)."""
+
+import argparse
+import importlib
+import os
+
+from .unicore_task import UnicoreTask  # noqa: F401
+
+TASK_REGISTRY = {}
+TASK_CLASS_NAMES = set()
+
+
+def setup_task(args, **kwargs):
+    return TASK_REGISTRY[args.task].setup_task(args, **kwargs)
+
+
+def register_task(name):
+    """Decorator registering a :class:`UnicoreTask` subclass."""
+
+    def register_task_cls(cls):
+        if name in TASK_REGISTRY:
+            raise ValueError(f"Cannot register duplicate task ({name})")
+        if not issubclass(cls, UnicoreTask):
+            raise ValueError(
+                f"Task ({name}: {cls.__name__}) must extend UnicoreTask"
+            )
+        if cls.__name__ in TASK_CLASS_NAMES:
+            raise ValueError(
+                f"Cannot register task with duplicate class name ({cls.__name__})"
+            )
+        TASK_REGISTRY[name] = cls
+        TASK_CLASS_NAMES.add(cls.__name__)
+        return cls
+
+    return register_task_cls
+
+
+def get_task(name):
+    return TASK_REGISTRY[name]
+
+
+# auto-import sibling modules so @register_task decorators run
+tasks_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(tasks_dir)):
+    path = os.path.join(tasks_dir, file)
+    if not file.startswith("_") and file.endswith(".py") and os.path.isfile(path):
+        importlib.import_module("unicore_tpu.tasks." + file[: file.find(".py")])

@@ -1,0 +1,296 @@
+"""Framework-wide utilities (TPU/jax-native).
+
+Covers the role of the reference's ``unicore/utils.py`` (tree mapping,
+device moves, RNG scoping, user-dir plugin import, activation checkpointing,
+tensor helpers used by Uni-Fold) re-designed for jax: tree ops are
+``jax.tree_util`` based, RNG scoping is explicit ``jax.random.fold_in``
+chains instead of stateful seeds, and device movement is ``jax.device_put``.
+"""
+
+import importlib
+import logging
+import os
+import sys
+import warnings
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Lazy jax import guard: data-pipeline-only users (e.g. preprocessing on a
+# CPU box) shouldn't pay jax import cost. Modules that need jax import it
+# directly; utils keeps host-side helpers importable stand-alone.
+# ---------------------------------------------------------------------------
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# Tree utilities (reference: apply_to_sample utils.py:38, tree_map :386,
+# tensor_tree_map :402)
+# ---------------------------------------------------------------------------
+
+
+def apply_to_sample(f, sample):
+    """Apply ``f`` to every array leaf of a nested sample structure."""
+    if sample is None or (hasattr(sample, "__len__") and len(sample) == 0):
+        return {}
+
+    def _apply(x):
+        if isinstance(x, np.ndarray):
+            return f(x)
+        if type(x).__module__.startswith("jax"):
+            return f(x)
+        if isinstance(x, dict):
+            return {k: _apply(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(_apply(v) for v in x)
+        return x
+
+    return _apply(sample)
+
+
+def tree_map(fn, tree, leaf_type=None):
+    if leaf_type is not None and isinstance(tree, leaf_type):
+        return fn(tree)
+    if isinstance(tree, dict):
+        return {k: tree_map(fn, v, leaf_type) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(tree_map(fn, v, leaf_type) for v in tree)
+    if leaf_type is None:
+        return fn(tree)
+    raise ValueError(f"Not supported leaf type {type(tree)}")
+
+
+def tensor_tree_map(fn, tree):
+    return _jax().tree_util.tree_map(fn, tree)
+
+
+def move_to_device(sample, device=None, sharding=None):
+    """Host->device transfer for a sample tree (reference move_to_cuda
+    utils.py:59). With a sharding, places the global batch across the mesh."""
+    jax = _jax()
+    target = sharding if sharding is not None else device
+
+    def _move(x):
+        return jax.device_put(x, target) if target is not None else jax.device_put(x)
+
+    return apply_to_sample(_move, sample)
+
+
+def move_to_cpu(sample, upcast=True):
+    """Device->host; bf16/fp16 leaves upcast to fp32 for stable serialization
+    (reference utils.py:70-79)."""
+
+    def _move(x):
+        x = np.asarray(x)
+        if upcast and x.dtype in (np.float16, _ml_dtype("bfloat16")):
+            x = x.astype(np.float32)
+        return x
+
+    return apply_to_sample(_move, sample)
+
+
+def _ml_dtype(name):
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+# ---------------------------------------------------------------------------
+# RNG scoping. The reference scopes stateful torch seeds as
+# (seed, num_updates, micro_batch, rank) for dropout decorrelation
+# (trainer.py:610-616). jax equivalent: fold_in chains on an explicit key.
+# ---------------------------------------------------------------------------
+
+
+def make_rng(seed, *scope):
+    """Build a PRNG key deterministically scoped by integers, e.g.
+    ``make_rng(seed, num_updates, micro_batch_idx, dp_rank)``."""
+    jax = _jax()
+    key = jax.random.PRNGKey(seed)
+    for s in scope:
+        key = jax.random.fold_in(key, s)
+    return key
+
+
+def numpy_seed(seed, *addl_seeds):
+    """Context manager that forks the global numpy RNG state. Single source
+    of truth lives in data_utils (re-exported here for convenience)."""
+    from unicore_tpu.data.data_utils import numpy_seed as _numpy_seed
+
+    return _numpy_seed(seed, *addl_seeds)
+
+
+# ---------------------------------------------------------------------------
+# --user-dir plugin loading (reference utils.py:133-164)
+# ---------------------------------------------------------------------------
+
+
+def import_user_module(args):
+    raw_path = getattr(args, "user_dir", None)
+    if raw_path is None:
+        return
+    module_path = os.path.abspath(raw_path)
+    if not os.path.exists(module_path):
+        # fall back to resolving the *raw* path relative to the package root
+        pkg_rel_path = os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", raw_path)
+        )
+        if os.path.exists(pkg_rel_path):
+            module_path = pkg_rel_path
+        else:
+            raise FileNotFoundError(module_path)
+    module_parent, module_name = os.path.split(module_path)
+    if module_name not in sys.modules:
+        sys.path.insert(0, module_parent)
+        importlib.import_module(module_name)
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# Gradient / parameter norms
+# ---------------------------------------------------------------------------
+
+
+def global_norm(tree):
+    """L2 norm over all leaves of a pytree, computed in fp32 (the analogue of
+    the reference's multi-tensor L2 norm, utils.py:81-103 — XLA fuses the
+    per-leaf reductions into one pass)."""
+    jax = _jax()
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), dtype=jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def clip_grad_norm(grads, max_norm):
+    """Clip a gradient pytree to a max global norm. Returns (grads, norm).
+    max_norm <= 0 means no clipping (norm still computed for logging)."""
+    import jax.numpy as jnp
+
+    norm = global_norm(grads)
+    if max_norm is None or max_norm <= 0:
+        return grads, norm
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    jax = _jax()
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads), norm
+
+
+# ---------------------------------------------------------------------------
+# Activation checkpointing (reference checkpoint_sequential utils.py:296-322)
+# ---------------------------------------------------------------------------
+
+
+def checkpoint_sequential(functions, input_x, enabled=True):
+    """Apply a list of fns sequentially, rematerializing each on the backward
+    pass when enabled (jax.checkpoint is the TPU-native equivalent)."""
+    jax = _jax()
+    if enabled:
+        functions = [jax.checkpoint(f) for f in functions]
+    for f in functions:
+        input_x = f(input_x)
+    return input_x
+
+
+# ---------------------------------------------------------------------------
+# Tensor helpers used by Uni-Fold-style models (reference utils.py:325-383)
+# ---------------------------------------------------------------------------
+
+
+def permute_final_dims(tensor, inds):
+    import jax.numpy as jnp
+
+    zero_index = -1 * len(inds)
+    first_inds = list(range(tensor.ndim + zero_index))
+    return jnp.transpose(tensor, first_inds + [zero_index + i for i in inds])
+
+
+def flatten_final_dims(tensor, num_dims):
+    return tensor.reshape(tensor.shape[:-num_dims] + (-1,))
+
+
+def masked_mean(mask, value, axis, eps=1e-10):
+    import jax.numpy as jnp
+
+    mask = mask.astype(value.dtype)
+    return jnp.sum(mask * value, axis=axis) / (eps + jnp.sum(mask, axis=axis))
+
+
+def one_hot(x, num_classes, dtype=None):
+    import jax
+
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def batched_gather(data, inds, axis=0, num_batch_dims=0):
+    import jax.numpy as jnp
+
+    assert axis < 0 or axis - num_batch_dims >= 0
+    ranges = []
+    for i, s in enumerate(data.shape[:num_batch_dims]):
+        r = jnp.arange(s)
+        r = r.reshape(*(*((1,) * i), -1, *((1,) * (len(inds.shape) - i - 1))))
+        ranges.append(r)
+    remaining_dims = [slice(None) for _ in range(len(data.shape) - num_batch_dims)]
+    remaining_dims[axis - num_batch_dims if axis >= 0 else axis] = inds
+    ranges.extend(remaining_dims)
+    return data[tuple(ranges)]
+
+
+# ---------------------------------------------------------------------------
+# Misc host helpers
+# ---------------------------------------------------------------------------
+
+
+def get_host_memory_gb():
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable"):
+                    return int(line.split()[1]) / 1024 / 1024
+    except OSError:
+        pass
+    return None
+
+
+def eval_str_list(x, type=float):
+    if x is None:
+        return None
+    if isinstance(x, str):
+        x = eval(x)
+    try:
+        return list(map(type, x))
+    except TypeError:
+        return [type(x)]
+
+
+def eval_bool(x, default=False):
+    if x is None:
+        return default
+    try:
+        return bool(eval(x))
+    except (TypeError, SyntaxError):
+        return default
+
+
+def has_parameters(obj):
+    """True when a loss/task carries trainable parameters of its own."""
+    params = getattr(obj, "params", None)
+    return params is not None and len(_jax().tree_util.tree_leaves(params)) > 0
+
+
+def warn_once(msg, _seen=set()):
+    if msg not in _seen:
+        _seen.add(msg)
+        warnings.warn(msg)
