@@ -1,14 +1,25 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh so
-multi-chip sharding logic is exercised without TPU hardware.
+multi-chip sharding logic is exercised without TPU hardware, and so the suite
+is fast/deterministic.  Set UNICORE_TPU_TEST_ON_TPU=1 to run the suite
+against the real chip instead (e.g. for Pallas kernel parity on hardware).
 
-Env vars must be set before jax initializes its backends, hence this runs at
-conftest import time (pytest imports conftest before test modules).
+The dev image registers the TPU PJRT plugin from sitecustomize at
+interpreter start, so JAX_PLATFORMS in the environment is not enough — we
+must override the jax config before any backend is initialized.  conftest
+import time is early enough (pytest imports conftest before test modules).
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("UNICORE_TPU_TEST_ON_TPU", "") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,143 @@
+"""Parity tests for the functional ops, generalizing the reference's
+``tests/test_softmax.py`` pattern: compare the framework op against an
+independent eager composition (torch CPU here), across dims/dtypes, forward
+and backward — including the 5-D triangle-attention broadcast shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from unicore_tpu import ops
+
+
+def _torch_softmax(x, mask=None, bias=None):
+    t = torch.from_numpy(np.asarray(x, dtype=np.float32))
+    if mask is not None:
+        t = t + torch.from_numpy(np.asarray(mask, dtype=np.float32))
+    if bias is not None:
+        t = t + torch.from_numpy(np.asarray(bias, dtype=np.float32))
+    return torch.softmax(t, dim=-1).numpy()
+
+
+@pytest.mark.parametrize("k", [64, 128, 256, 1024, 1536])
+def test_softmax_dropout_forward(rng, k):
+    x = rng.randn(2, 4, 16, k).astype(np.float32)
+    mask = (rng.rand(2, 1, 1, k) > 0.5).astype(np.float32) * -10000.0
+    bias = rng.randn(1, 4, 16, k).astype(np.float32)
+    out = ops.softmax_dropout(
+        jnp.asarray(x), 0.0, is_training=False, mask=jnp.asarray(mask), bias=jnp.asarray(bias)
+    )
+    ref = _torch_softmax(x, mask, bias)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "mask_shape,bias_shape",
+    [
+        # Uni-Fold Evoformer patterns (reference tests/test_softmax.py:81-170)
+        ((2, 3, 1, 1, 32), (1, 1, 4, 16, 32)),
+        ((2, 3, 4, 1, 32), (1, 3, 4, 16, 32)),
+    ],
+)
+def test_softmax_dropout_triangle_broadcast(rng, mask_shape, bias_shape):
+    x = rng.randn(2, 3, 4, 16, 32).astype(np.float32)
+    mask = (rng.rand(*mask_shape) > 0.5).astype(np.float32) * -10000.0
+    bias = rng.randn(*bias_shape).astype(np.float32)
+    out = ops.softmax_dropout(
+        jnp.asarray(x), 0.0, is_training=False, mask=jnp.asarray(mask), bias=jnp.asarray(bias)
+    )
+    np.testing.assert_allclose(np.asarray(out), _torch_softmax(x, mask, bias), atol=1e-5)
+
+
+def test_softmax_dropout_grads_match_torch(rng):
+    x = rng.randn(2, 4, 8, 64).astype(np.float32)
+    bias = rng.randn(1, 4, 8, 64).astype(np.float32)
+
+    def f(x_, b_):
+        return jnp.sum(
+            ops.softmax_dropout(x_, 0.0, is_training=False, bias=b_) ** 2
+        )
+
+    gx, gb = jax.grad(f, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(bias))
+
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tb = torch.from_numpy(bias).requires_grad_(True)
+    (torch.softmax(tx + tb, dim=-1) ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), atol=1e-4)
+
+
+def test_softmax_dropout_training_mask_statistics(rng):
+    x = jnp.asarray(rng.randn(4, 16, 256).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    out, sm = ops.softmax_dropout_reference(
+        x, 0.5, rng=key, is_training=True, return_softmax=True
+    )
+    out = np.asarray(out)
+    # dropped entries are exactly zero; survivors are scaled by 1/keep
+    dropped = out == 0.0
+    frac = dropped.mean()
+    assert 0.4 < frac < 0.6
+    survivors = ~dropped
+    np.testing.assert_allclose(
+        out[survivors], (np.asarray(sm) / 0.5)[survivors], rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("dim", [64, 100, 768])
+def test_layer_norm_matches_torch(rng, dim):
+    x = rng.randn(3, 7, dim).astype(np.float32)
+    w = rng.randn(dim).astype(np.float32)
+    b = rng.randn(dim).astype(np.float32)
+    out = ops.layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    ref = torch.nn.functional.layer_norm(
+        torch.from_numpy(x), (dim,), torch.from_numpy(w), torch.from_numpy(b)
+    ).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_layer_norm_bf16_fp32_stats(rng):
+    # bf16 input must use fp32 statistics: normalizing the bf16-quantized
+    # input in fp32 (torch semantics) must agree with our bf16 path
+    x = (rng.randn(4, 128) + 300.0).astype(np.float32)
+    x_bf16 = jnp.asarray(x, dtype=jnp.bfloat16)
+    out_bf16 = ops.layer_norm(x_bf16)
+    ref = ops.layer_norm_reference(x_bf16.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(out_bf16, dtype=np.float32), np.asarray(ref), atol=0.1
+    )
+
+
+def test_fp32_to_bf16_sr_unbiased():
+    # stochastic rounding must be unbiased: mean of many rounded copies
+    # converges to the fp32 value, unlike truncation
+    x = jnp.full((10000,), 1.0 + 1.0 / 512.0, dtype=jnp.float32)
+    out = ops.fp32_to_bf16_sr(x, jax.random.PRNGKey(7))
+    vals = np.asarray(out, dtype=np.float32)
+    # bf16 neighbors of 1+1/512 are 1.0 and 1.0078125; both must occur
+    assert set(np.unique(vals)) == {1.0, 1.0078125}
+    np.testing.assert_allclose(vals.mean(), 1.0 + 1.0 / 512.0, rtol=3e-4)
+
+
+def test_fp32_to_bf16_sr_exact_values_stable():
+    # values already representable in bf16 never move
+    x = jnp.asarray([0.0, 1.0, -2.5, 0.15625], dtype=jnp.float32)
+    out = ops.fp32_to_bf16_sr(x, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(
+        np.asarray(out, dtype=np.float32), np.asarray(x)
+    )
+
+
+def test_l2_norm_tree(rng):
+    tree = {
+        "a": jnp.asarray(rng.randn(17, 5).astype(np.float32)),
+        "b": {"c": jnp.asarray(rng.randn(3).astype(np.float32))},
+    }
+    flat = np.concatenate(
+        [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(tree)]
+    )
+    np.testing.assert_allclose(
+        float(ops.l2_norm(tree)), np.linalg.norm(flat), rtol=1e-6
+    )

@@ -1,0 +1,290 @@
+"""Optimizer parity vs torch.optim (the independent oracle) and
+scheduler/scaler behavior tests."""
+
+from argparse import Namespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from unicore_tpu.optim import OPTIMIZER_REGISTRY, build_optimizer
+from unicore_tpu.optim.dynamic_loss_scaler import (
+    DynamicLossScaler,
+    scaler_init,
+    scaler_update,
+)
+from unicore_tpu.optim.fp16_optimizer import (
+    grads_finite,
+    make_master_params,
+    sync_master_to_model,
+)
+from unicore_tpu.optim.lr_scheduler import LR_SCHEDULER_REGISTRY, build_lr_scheduler
+
+
+def _run_steps(opt, params, grad_seq, lr):
+    state = opt.init(params)
+    for g in grad_seq:
+        updates, state = opt.update(g, state, params, lr=lr)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), params, updates
+        )
+    return params
+
+
+def _torch_steps(torch_opt_cls, tensors, grad_seq, **kw):
+    ps = [torch.nn.Parameter(torch.from_numpy(t.copy())) for t in tensors]
+    opt = torch_opt_cls(ps, **kw)
+    for gs in grad_seq:
+        for p, g in zip(ps, gs):
+            p.grad = torch.from_numpy(np.asarray(g).copy())
+        opt.step()
+    return [p.detach().numpy() for p in ps]
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_adam_matches_torch_adamw(rng, wd):
+    t1, t2 = rng.randn(7, 5).astype(np.float32), rng.randn(13).astype(np.float32)
+    grads = [
+        (rng.randn(7, 5).astype(np.float32), rng.randn(13).astype(np.float32))
+        for _ in range(5)
+    ]
+    args = Namespace(lr=[1e-2], adam_betas="(0.9, 0.98)", adam_eps=1e-8,
+                     weight_decay=wd)
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    params = {"a": jnp.asarray(t1), "b": jnp.asarray(t2)}
+    out = _run_steps(
+        opt, params, [{"a": jnp.asarray(g[0]), "b": jnp.asarray(g[1])} for g in grads],
+        lr=1e-2,
+    )
+    ref = _torch_steps(
+        torch.optim.AdamW, [t1, t2], grads,
+        lr=1e-2, betas=(0.9, 0.98), eps=1e-8, weight_decay=wd,
+    )
+    np.testing.assert_allclose(np.asarray(out["a"]), ref[0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["b"]), ref[1], atol=1e-5)
+
+
+@pytest.mark.parametrize("momentum,wd", [(0.0, 0.0), (0.9, 0.01)])
+def test_sgd_matches_torch(rng, momentum, wd):
+    t = rng.randn(6, 4).astype(np.float32)
+    grads = [rng.randn(6, 4).astype(np.float32) for _ in range(4)]
+    args = Namespace(lr=[0.1], momentum=momentum, weight_decay=wd)
+    opt = OPTIMIZER_REGISTRY["sgd"](args)
+    out = _run_steps(opt, {"p": jnp.asarray(t)},
+                     [{"p": jnp.asarray(g)} for g in grads], lr=0.1)
+    ref = _torch_steps(torch.optim.SGD, [t], [[g] for g in grads],
+                       lr=0.1, momentum=momentum, weight_decay=wd)
+    np.testing.assert_allclose(np.asarray(out["p"]), ref[0], atol=1e-6)
+
+
+def test_adagrad_matches_torch(rng):
+    t = rng.randn(5, 3).astype(np.float32)
+    grads = [rng.randn(5, 3).astype(np.float32) for _ in range(4)]
+    args = Namespace(lr=[0.05], weight_decay=0.0)
+    opt = OPTIMIZER_REGISTRY["adagrad"](args)
+    out = _run_steps(opt, {"p": jnp.asarray(t)},
+                     [{"p": jnp.asarray(g)} for g in grads], lr=0.05)
+    ref = _torch_steps(torch.optim.Adagrad, [t], [[g] for g in grads], lr=0.05)
+    np.testing.assert_allclose(np.asarray(out["p"]), ref[0], atol=1e-6)
+
+
+def test_adadelta_matches_torch(rng):
+    t = rng.randn(5, 3).astype(np.float32)
+    grads = [rng.randn(5, 3).astype(np.float32) for _ in range(4)]
+    args = Namespace(lr=[1.0], adadelta_rho=0.9, adadelta_eps=1e-6, weight_decay=0.0)
+    opt = OPTIMIZER_REGISTRY["adadelta"](args)
+    out = _run_steps(opt, {"p": jnp.asarray(t)},
+                     [{"p": jnp.asarray(g)} for g in grads], lr=1.0)
+    ref = _torch_steps(torch.optim.Adadelta, [t], [[g] for g in grads],
+                       lr=1.0, rho=0.9, eps=1e-6)
+    np.testing.assert_allclose(np.asarray(out["p"]), ref[0], atol=1e-6)
+
+
+def test_optimizer_registry_contents():
+    for name in ("adam", "sgd", "adagrad", "adadelta"):
+        assert name in OPTIMIZER_REGISTRY
+
+
+def test_build_optimizer_from_args():
+    args = Namespace(optimizer="adam", lr=[1e-3], adam_betas="(0.9, 0.999)",
+                     adam_eps=1e-8, weight_decay=0.0)
+    opt = build_optimizer(args)
+    assert opt.__class__.__name__ == "UnicoreAdam"
+
+
+# -- schedulers --------------------------------------------------------------
+
+
+def _sched(name, opt_args=None, total=None, **kw):
+    defaults = dict(lr=[1.0])
+    defaults.update(kw)
+    args = Namespace(**defaults)
+    opt = OPTIMIZER_REGISTRY["adam"](
+        Namespace(lr=args.lr, adam_betas="(0.9, 0.999)", adam_eps=1e-8,
+                  weight_decay=0.0)
+    )
+    return LR_SCHEDULER_REGISTRY[name](args, opt, total)
+
+
+def test_scheduler_registry_contents():
+    for name in (
+        "fixed", "cosine", "inverse_sqrt", "polynomial_decay",
+        "exponential_decay", "triangular", "tri_stage",
+        "reduce_lr_on_plateau", "pass_through",
+    ):
+        assert name in LR_SCHEDULER_REGISTRY
+
+
+def test_fixed_schedule_warmup():
+    s = _sched("fixed", lr=[2.0], force_anneal=None, lr_shrink=0.1,
+               warmup_updates=10)
+    s.step_begin_epoch(1)
+    lrs = [s.step_update(i) for i in range(12)]
+    np.testing.assert_allclose(lrs[0], 0.2)
+    np.testing.assert_allclose(lrs[9], 2.0)
+    np.testing.assert_allclose(lrs[11], 2.0)
+
+
+def test_inverse_sqrt_schedule():
+    s = _sched("inverse_sqrt", lr=[1e-3], warmup_updates=100, warmup_init_lr=-1)
+    lr_w = s.step_update(50)
+    np.testing.assert_allclose(lr_w, 1e-3 * 50 / 100, rtol=1e-6)
+    lr_after = s.step_update(400)
+    np.testing.assert_allclose(lr_after, 1e-3 * (100 ** 0.5) * 400 ** -0.5, rtol=1e-6)
+
+
+def test_polynomial_decay_schedule():
+    s = _sched("polynomial_decay", lr=[1e-4], warmup_updates=10, warmup_ratio=-1.0,
+               end_learning_rate=0.0, power=1.0, total_num_update=110,
+               force_anneal=None)
+    np.testing.assert_allclose(s.step_update(5), 1e-4 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(s.step_update(60), 1e-4 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(s.step_update(110), 0.0, atol=1e-12)
+
+
+def test_polynomial_decay_warmup_ratio_uses_total_steps():
+    s = _sched("polynomial_decay", total=1000, lr=[1e-4], warmup_updates=0,
+               warmup_ratio=0.1, end_learning_rate=0.0, power=1.0,
+               total_num_update=0, force_anneal=None)
+    assert s.warmup_updates == 100
+    assert s.total_num_update == 1000
+
+
+def test_cosine_schedule():
+    s = _sched("cosine", lr=[1.0], warmup_updates=0, warmup_init_lr=-1,
+               min_lr=0.0, max_lr=None, t_mult=1, lr_period_updates=100,
+               lr_shrink=1.0, max_update=0)
+    np.testing.assert_allclose(s.step_update(0), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(s.step_update(50), 0.5, atol=1e-6)
+    np.testing.assert_allclose(s.step_update(100), 1.0, rtol=1e-6)  # new cycle
+
+
+def test_exponential_decay_schedule():
+    s = _sched("exponential_decay", lr=[1.0], warmup_updates=0, decay_ratio=0.5,
+               decay_steps=10, stair_decay=True)
+    np.testing.assert_allclose(s.step_update(25), 0.25, rtol=1e-6)
+
+
+def test_triangular_schedule():
+    s = _sched("triangular", lr=[0.1], max_lr=1.0, lr_period_updates=100,
+               lr_shrink=1.0, shrink_min=False)
+    np.testing.assert_allclose(s.step_update(0), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(s.step_update(50), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(s.step_update(100), 0.1, rtol=1e-6)
+
+
+def test_tri_stage_schedule():
+    s = _sched("tri_stage", lr=[1.0], warmup_steps=10, hold_steps=10,
+               decay_steps=10, phase_ratio=None, init_lr_scale=0.01,
+               final_lr_scale=0.01, max_update=0)
+    np.testing.assert_allclose(s.step_update(0), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(s.step_update(10), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s.step_update(15), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s.step_update(1000), 0.01, rtol=1e-5)
+
+
+def test_reduce_lr_on_plateau():
+    s = _sched("reduce_lr_on_plateau", lr=[1.0], lr_shrink=0.5, lr_threshold=1e-4,
+               lr_patience=0, warmup_updates=0, warmup_init_lr=-1)
+    s.step(1, val_loss=1.0)
+    assert s.optimizer.get_lr() == 1.0
+    s.step(2, val_loss=0.5)  # improvement
+    assert s.optimizer.get_lr() == 1.0
+    s.step(3, val_loss=0.5)  # plateau -> shrink
+    np.testing.assert_allclose(s.optimizer.get_lr(), 0.5)
+
+
+def test_scheduler_state_roundtrip():
+    s = _sched("fixed", lr=[2.0], force_anneal=None, lr_shrink=0.1,
+               warmup_updates=0)
+    s.step_begin_epoch(1)
+    sd = s.state_dict()
+    s2 = _sched("fixed", lr=[2.0], force_anneal=None, lr_shrink=0.1,
+                warmup_updates=0)
+    s2.load_state_dict(sd)
+    assert s2.lr == s.lr
+
+
+# -- loss scaler -------------------------------------------------------------
+
+
+def test_host_scaler_overflow_flow():
+    s = DynamicLossScaler(init_scale=16.0, scale_window=2, min_loss_scale=0.25)
+    with pytest.raises(OverflowError):
+        s.check_overflow(float("inf"))
+    assert s.loss_scale == 8.0
+    with pytest.raises(OverflowError):
+        s.check_overflow(float("nan"))
+    assert s.loss_scale == 4.0
+    # clean steps grow after window
+    start = s.loss_scale
+    s.update()
+    s.update()
+    assert s.loss_scale >= start
+
+
+def test_host_scaler_min_scale_abort():
+    s = DynamicLossScaler(init_scale=0.5, scale_window=2, min_loss_scale=0.3)
+    with pytest.raises(FloatingPointError):
+        s.check_overflow(float("inf"))
+
+
+def test_functional_scaler():
+    st = scaler_init(16.0)
+    st = scaler_update(st, jnp.asarray(True), scale_window=2)
+    assert float(st["scale"]) == 8.0
+    st = scaler_update(st, jnp.asarray(False), scale_window=2)
+    st = scaler_update(st, jnp.asarray(False), scale_window=2)
+    assert float(st["scale"]) == 16.0  # grew after 2 clean steps
+
+
+# -- mixed precision helpers --------------------------------------------------
+
+
+def test_master_copy_roundtrip(rng):
+    p = {"w": jnp.asarray(rng.randn(33, 5).astype(np.float32), dtype=jnp.bfloat16)}
+    master = make_master_params(p)
+    assert master["w"].dtype == jnp.float32
+    model = sync_master_to_model(master, jnp.bfloat16)
+    assert model["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(model["w"], dtype=np.float32),
+        np.asarray(p["w"], dtype=np.float32),
+    )
+
+
+def test_sync_with_stochastic_rounding(rng):
+    x = np.full((4096,), 1.0 + 1.0 / 512.0, dtype=np.float32)
+    master = {"w": jnp.asarray(x)}
+    model = sync_master_to_model(master, jnp.bfloat16, sr_rng=jax.random.PRNGKey(0))
+    vals = np.asarray(model["w"], dtype=np.float32)
+    assert set(np.unique(vals)) == {1.0, 1.0078125}
+
+
+def test_grads_finite():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    bad = {"a": jnp.asarray([1.0, jnp.inf, 0.0]), "b": jnp.zeros((2, 2))}
+    assert bool(grads_finite(good))
+    assert not bool(grads_finite(bad))

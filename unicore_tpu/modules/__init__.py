@@ -1,1 +1,22 @@
-"""NN modules (flax) — populated incrementally."""
+"""NN modules (flax) — parity surface of ``unicore/modules/__init__.py:1-9``."""
+
+from unicore_tpu.ops import layer_norm as layer_norm_fn  # noqa: F401
+from unicore_tpu.ops import softmax_dropout  # noqa: F401
+
+from .layer_norm import LayerNorm  # noqa: F401
+from .multihead_attention import (  # noqa: F401
+    CrossMultiheadAttention,
+    SelfMultiheadAttention,
+    bert_init,
+)
+from .transformer_encoder import (  # noqa: F401
+    TransformerEncoder,
+    TransformerEncoderLayer,
+    make_rp_bucket,
+    relative_position_bucket,
+)
+from .transformer_decoder import (  # noqa: F401
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    future_mask,
+)

@@ -1,0 +1,26 @@
+"""LayerNorm flax module.
+
+Parity target: ``unicore/modules/layer_norm.py:22-83`` — affine params stored
+fp32 (cast to input dtype per-call), statistics in fp32, fused kernel when
+eligible.  The dim whitelist (``FUSED_LAYER_NORM_SUPPORT_DIM``) becomes a
+lane-multiple rule inside ``ops.layer_norm``.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu import ops
+
+
+class LayerNorm(nn.Module):
+    dim: int
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        weight = bias = None
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, (self.dim,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (self.dim,), jnp.float32)
+        return ops.layer_norm(x, weight=weight, bias=bias, eps=self.eps)

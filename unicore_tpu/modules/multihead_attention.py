@@ -1,0 +1,161 @@
+"""Self/cross multi-head attention flax modules.
+
+Parity target: ``unicore/modules/multihead_attention.py`` —
+``SelfMultiheadAttention`` (fused QKV projection, ``scaling_factor`` knob,
+key-padding -inf fill, additive attn bias through the fused softmax) and
+``CrossMultiheadAttention`` (separate q/k/v projections).
+
+TPU-first redesign: the reference flattens to ``[B*H, T, D]`` and uses
+``torch.bmm``; here heads stay a named axis — ``[B, T, H, D]`` einsums — so
+XLA maps the contractions straight onto the MXU and shardings can target the
+head axis (tensor parallelism) without reshapes.  ``attn_bias`` accepts
+anything broadcastable to ``[B, H, q, k]``; the reference's ``[B*H, q, k]``
+convention is detected and reshaped.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu import ops
+
+bert_init = nn.initializers.normal(stddev=0.02)
+
+
+def _canon_bias(bias, bsz, num_heads):
+    """Accept [B*H, q, k] (reference convention) or anything broadcastable to
+    [B, H, q, k]."""
+    if bias is None:
+        return None
+    if bias.ndim == 3 and bias.shape[0] == bsz * num_heads:
+        return bias.reshape(bsz, num_heads, bias.shape[1], bias.shape[2])
+    return bias
+
+
+def _padding_bias(key_padding_mask, dtype):
+    """[B, S] bool/int mask (True = pad) -> additive [B, 1, 1, S] -inf bias."""
+    if key_padding_mask is None:
+        return None
+    neg_inf = jnp.asarray(float("-inf"), dtype=jnp.float32)
+    return jnp.where(
+        key_padding_mask.astype(bool)[:, None, None, :], neg_inf, 0.0
+    )
+
+
+def _attend(q, k, v, scaling, dropout, mask, bias, deterministic, make_rng,
+            return_attn=False):
+    """Core attention: q/k/v are [B, T, H, D]."""
+    dtype = q.dtype
+    # [B, H, q, k] scores; contraction + batched dims map directly to MXU.
+    attn_weights = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k)
+    if mask is not None:
+        attn_weights = attn_weights + mask.astype(jnp.float32).astype(dtype)
+    rng = None
+    if not deterministic and dropout > 0.0:
+        rng = make_rng("dropout")
+    if return_attn:
+        attn_weights = attn_weights if bias is None else attn_weights + bias.astype(dtype)
+        probs = ops.softmax_dropout(
+            attn_weights, dropout, rng=rng, is_training=not deterministic
+        )
+    else:
+        probs = ops.softmax_dropout(
+            attn_weights, dropout, rng=rng, is_training=not deterministic, bias=bias
+        )
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if return_attn:
+        return o, attn_weights, probs
+    return o
+
+
+class SelfMultiheadAttention(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.1
+    bias: bool = True
+    scaling_factor: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        query,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        return_attn: bool = False,
+        deterministic: bool = True,
+    ):
+        bsz, tgt_len, embed_dim = query.shape
+        assert embed_dim == self.embed_dim
+        head_dim = self.embed_dim // self.num_heads
+        assert head_dim * self.num_heads == self.embed_dim
+        scaling = (head_dim * self.scaling_factor) ** -0.5
+
+        qkv = nn.Dense(
+            3 * self.embed_dim,
+            use_bias=self.bias,
+            kernel_init=bert_init,
+            name="in_proj",
+        )(query)
+        qkv = qkv.reshape(bsz, tgt_len, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        mask = _padding_bias(key_padding_mask, query.dtype)
+        bias = _canon_bias(attn_bias, bsz, self.num_heads)
+        out = _attend(
+            q, k, v, scaling, self.dropout, mask, bias, deterministic,
+            self.make_rng, return_attn=return_attn,
+        )
+        if return_attn:
+            o, attn_weights, probs = out
+        else:
+            o = out
+        o = o.reshape(bsz, tgt_len, embed_dim)
+        o = nn.Dense(
+            self.embed_dim, use_bias=self.bias, kernel_init=bert_init,
+            name="out_proj",
+        )(o)
+        if return_attn:
+            return o, attn_weights, probs
+        return o
+
+
+class CrossMultiheadAttention(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.1
+    bias: bool = True
+    scaling_factor: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        query,
+        key,
+        value,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ):
+        bsz, tgt_len, embed_dim = query.shape
+        assert embed_dim == self.embed_dim
+        head_dim = self.embed_dim // self.num_heads
+        scaling = (head_dim * self.scaling_factor) ** -0.5
+
+        def proj(x, name):
+            y = nn.Dense(
+                self.embed_dim, use_bias=self.bias, kernel_init=bert_init, name=name
+            )(x)
+            return y.reshape(y.shape[0], y.shape[1], self.num_heads, head_dim)
+
+        q = proj(query, "q_proj")
+        k = proj(key, "k_proj")
+        v = proj(value, "v_proj")
+
+        mask = _padding_bias(key_padding_mask, query.dtype)
+        bias = _canon_bias(attn_bias, bsz, self.num_heads)
+        o = _attend(q, k, v, scaling, self.dropout, mask, bias, deterministic, self.make_rng)
+        o = o.reshape(bsz, tgt_len, embed_dim)
+        return nn.Dense(
+            self.embed_dim, use_bias=self.bias, kernel_init=bert_init, name="out_proj"
+        )(o)

@@ -1,0 +1,182 @@
+"""Transformer decoder with causal masking and cross-attention.
+
+Parity target: ``unicore/modules/transformer_decoder.py`` (future mask merged
+into the additive attention mask when ``auto_regressive``; same rel-pos bias
+and padding-merge scheme as the encoder) and
+``transformer_decoder_layer.py`` (self-attn -> optional cross-attn -> FFN).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .layer_norm import LayerNorm
+from .multihead_attention import CrossMultiheadAttention, SelfMultiheadAttention, bert_init
+from .transformer_encoder import RelativePositionBias
+from unicore_tpu.utils import get_activation_fn
+
+
+def future_mask(seq_len, dtype=jnp.float32):
+    """[T, T] additive causal mask: 0 on/below diagonal, -inf above
+    (reference: transformer_decoder.py:19-22)."""
+    return jnp.triu(
+        jnp.full((seq_len, seq_len), float("-inf"), dtype=dtype), k=1
+    )
+
+
+class TransformerDecoderLayer(nn.Module):
+    embed_dim: int = 768
+    ffn_embed_dim: int = 3072
+    attention_heads: int = 8
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        encoder_out: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        encoder_attn_bias: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ):
+        act = get_activation_fn(self.activation_fn)
+
+        def drop(h, rate):
+            if deterministic or rate == 0.0:
+                return h
+            return nn.Dropout(rate=rate, deterministic=False)(h, rng=self.make_rng("dropout"))
+
+        residual = x
+        if not self.post_ln:
+            x = LayerNorm(self.embed_dim, name="self_attn_layer_norm")(x)
+        x = SelfMultiheadAttention(
+            self.embed_dim,
+            self.attention_heads,
+            dropout=self.attention_dropout,
+            name="self_attn",
+        )(x, key_padding_mask=padding_mask, attn_bias=attn_bias,
+          deterministic=deterministic)
+        x = drop(x, self.dropout)
+        x = residual + x
+        if self.post_ln:
+            x = LayerNorm(self.embed_dim, name="self_attn_layer_norm")(x)
+
+        if encoder_out is not None:
+            residual = x
+            if not self.post_ln:
+                x = LayerNorm(self.embed_dim, name="encoder_attn_layer_norm")(x)
+            x = CrossMultiheadAttention(
+                self.embed_dim,
+                self.attention_heads,
+                dropout=self.attention_dropout,
+                name="encoder_attn",
+            )(x, encoder_out, encoder_out,
+              key_padding_mask=encoder_padding_mask,
+              attn_bias=encoder_attn_bias,
+              deterministic=deterministic)
+            x = drop(x, self.dropout)
+            x = residual + x
+            if self.post_ln:
+                x = LayerNorm(self.embed_dim, name="encoder_attn_layer_norm")(x)
+
+        residual = x
+        if not self.post_ln:
+            x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
+        x = nn.Dense(self.ffn_embed_dim, kernel_init=bert_init, name="fc1")(x)
+        x = act(x)
+        x = drop(x, self.activation_dropout)
+        x = nn.Dense(self.embed_dim, kernel_init=bert_init, name="fc2")(x)
+        x = drop(x, self.dropout)
+        x = residual + x
+        if self.post_ln:
+            x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
+        return x
+
+
+class TransformerDecoder(nn.Module):
+    decoder_layers: int = 6
+    embed_dim: int = 768
+    ffn_embed_dim: int = 3072
+    attention_heads: int = 8
+    emb_dropout: float = 0.1
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 256
+    activation_fn: str = "gelu"
+    rel_pos: bool = True
+    rel_pos_bins: int = 32
+    max_rel_pos: int = 128
+    post_ln: bool = False
+    auto_regressive: bool = True
+
+    @nn.compact
+    def __call__(
+        self,
+        emb,
+        encoder_out: Optional[jnp.ndarray] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        encoder_attn_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ):
+        bsz, seq_len, _ = emb.shape
+        x = LayerNorm(self.embed_dim, name="emb_layer_norm")(emb)
+        if not deterministic and self.emb_dropout > 0.0:
+            x = nn.Dropout(rate=self.emb_dropout, deterministic=False)(
+                x, rng=self.make_rng("dropout")
+            )
+
+        if padding_mask is not None:
+            x = x * (1 - padding_mask[..., None].astype(x.dtype))
+
+        if attn_mask is not None and attn_mask.ndim == 3:
+            attn_mask = attn_mask.reshape(bsz, -1, seq_len, seq_len)
+        if self.rel_pos:
+            rel_pos_bias = RelativePositionBias(
+                self.rel_pos_bins, self.attention_heads, self.max_seq_len,
+                self.max_rel_pos, name="relative_attention_bias",
+            )(seq_len)
+            attn_mask = rel_pos_bias if attn_mask is None else attn_mask + rel_pos_bias
+        if self.auto_regressive:
+            fm = future_mask(seq_len)[None, None]
+            attn_mask = fm if attn_mask is None else attn_mask + fm
+
+        if attn_mask is not None and padding_mask is not None:
+            attn_mask = jnp.where(
+                padding_mask.astype(bool)[:, None, None, :],
+                jnp.asarray(float("-inf"), dtype=jnp.float32),
+                attn_mask.astype(jnp.float32),
+            )
+            padding_mask = None
+
+        for i in range(self.decoder_layers):
+            x = TransformerDecoderLayer(
+                embed_dim=self.embed_dim,
+                ffn_embed_dim=self.ffn_embed_dim,
+                attention_heads=self.attention_heads,
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                activation_dropout=self.activation_dropout,
+                activation_fn=self.activation_fn,
+                post_ln=self.post_ln,
+                name=f"layers_{i}",
+            )(x,
+              encoder_out=encoder_out,
+              attn_bias=attn_mask,
+              padding_mask=padding_mask,
+              encoder_attn_bias=encoder_attn_mask,
+              encoder_padding_mask=encoder_padding_mask,
+              deterministic=deterministic)
+
+        if not self.post_ln:
+            x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
+        return x

@@ -1,0 +1,65 @@
+"""Kernel backend selection.
+
+The reference gates each CUDA extension on import success + compute
+capability >= 7 (``unicore/utils.py:18-34``).  The TPU analogue: the Pallas
+path is eligible when the default jax backend is TPU; tests force either
+backend explicitly (the ``jnp`` implementations are the oracles).
+"""
+
+import contextlib
+import functools
+
+_BACKEND = "auto"  # auto | pallas | reference
+
+
+def set_kernel_backend(name):
+    """Force the kernel backend: ``auto`` (default), ``pallas``, or
+    ``reference``."""
+    global _BACKEND
+    assert name in ("auto", "pallas", "reference"), name
+    _BACKEND = name
+    _on_tpu.cache_clear()
+
+
+def get_kernel_backend():
+    return _BACKEND
+
+
+@contextlib.contextmanager
+def kernel_backend(name):
+    prev = _BACKEND
+    set_kernel_backend(name)
+    try:
+        yield
+    finally:
+        set_kernel_backend(prev)
+
+
+@functools.lru_cache(None)
+def _on_tpu():
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def use_pallas():
+    """Whether an op should take its Pallas kernel path."""
+    if _BACKEND == "pallas":
+        return True
+    if _BACKEND == "reference":
+        return False
+    return _on_tpu()
+
+
+def pallas_interpret():
+    """Interpret-mode setting for pallas_call: off-TPU (CPU tests) return
+    TPU InterpretParams so TPU-specific primitives (prng_seed,
+    stochastic_round, ...) are emulated; on TPU compile normally."""
+    if _on_tpu():
+        return False
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.InterpretParams()

@@ -1,0 +1,45 @@
+"""Fused LayerNorm.
+
+Behavioral spec from the reference (``unicore/modules/layer_norm.py:22-83``,
+``csrc/layernorm/layernorm.cu``): normalize over the last dim with fp32
+statistics (mean/invvar computed in fp32 even for bf16/fp16 inputs), affine
+weight/bias stored fp32 and cast to the input dtype for the multiply.
+
+The reference only fuses for 15 whitelisted dims (``FUSED_LAYER_NORM_SUPPORT_DIM``);
+the TPU analogue is a lane-multiple constraint (last dim % 128 == 0) for the
+Pallas path, with the jnp path covering everything else.
+"""
+
+import jax.numpy as jnp
+
+from .backend import use_pallas
+
+
+def layer_norm_reference(x, weight=None, bias=None, eps=1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    inv = jnp.reciprocal(jnp.sqrt(var + eps))
+    out = (xf - mean) * inv
+    out = out.astype(dtype)
+    if weight is not None:
+        out = out * weight.astype(dtype)
+    if bias is not None:
+        out = out + bias.astype(dtype)
+    return out
+
+
+def layer_norm(x, weight=None, bias=None, eps=1e-5):
+    rows = x.size // x.shape[-1] if x.shape[-1] else 0
+    if (
+        use_pallas()
+        and x.shape[-1] % 128 == 0
+        and rows % 8 == 0  # sublane-tileable row blocks (Mosaic constraint)
+        and weight is not None
+        and bias is not None
+    ):
+        from .pallas import layer_norm as pl_impl
+
+        return pl_impl.layer_norm(x, weight, bias, eps=eps)
+    return layer_norm_reference(x, weight=weight, bias=bias, eps=eps)

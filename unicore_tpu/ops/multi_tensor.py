@@ -1,0 +1,20 @@
+"""Global L2 norm over a parameter/gradient pytree.
+
+The reference's ``unicore_fused_multi_tensor`` CUDA extension
+(``csrc/multi_tensor/multi_tensor_l2norm_kernel.cu``) exists because eager
+PyTorch would launch one kernel per tensor; under XLA a tree-reduce of
+per-leaf sum-of-squares compiles into a fused reduction, so the jnp
+implementation is already the "multi-tensor apply" — one compiled program,
+no per-tensor launches.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def l2_norm(tree):
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    if not leaves:
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    total = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(total)

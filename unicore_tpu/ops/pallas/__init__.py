@@ -1,0 +1,7 @@
+"""Pallas (Mosaic) TPU kernels — the perf tier of ``unicore_tpu.ops``.
+
+TPU-native analogues of the reference's CUDA extensions
+(``csrc/``, ``setup.py:112-202``).  Each kernel is validated against the
+``jnp`` reference implementation in ``tests/test_pallas.py`` (run with
+``UNICORE_TPU_TEST_ON_TPU=1`` on hardware; interpret mode on CPU).
+"""

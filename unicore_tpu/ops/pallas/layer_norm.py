@@ -1,0 +1,121 @@
+"""Fused LayerNorm Pallas kernel.
+
+TPU-native analogue of ``csrc/layernorm/layernorm.cu`` /
+``layernorm_backward.cu``.  The CUDA forward returns ``(out, mean, invvar)``
+and the backward reads the saved statistics; on TPU the statistics are two
+cheap row reductions, so the backward *recomputes* them from the saved input
+instead — saving the HBM round-trip and avoiding sub-lane 1-D outputs that
+Mosaic tiles poorly.  dgamma/dbeta are whole-column reductions left to XLA
+(the CUDA version needed a second dedicated extension for them).
+
+Rows are tiled ``[r_blk, dim]`` in VMEM; the normalized dim must be a
+128-lane multiple (the analogue of the reference's
+``FUSED_LAYER_NORM_SUPPORT_DIM`` whitelist, ``layer_norm.py:48``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from unicore_tpu.ops.backend import pallas_interpret
+
+
+def _pick_r_blk(rows, dim):
+    budget = 1 << 20
+    blk = min(rows, max(8, budget // max(dim, 1)))
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if cand <= blk and rows % cand == 0:
+            return cand
+    return rows  # whole array (rows < 8 or odd row count)
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, out_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    xhat = xc * jax.lax.rsqrt(var + eps)
+    out_ref[...] = (
+        xhat.astype(out_ref.dtype) * w_ref[...].astype(out_ref.dtype)
+        + b_ref[...].astype(out_ref.dtype)
+    )
+
+
+def _bwd_kernel(g_ref, x_ref, w_ref, dx_ref, *, eps):
+    g = g_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = xc * inv
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = inv * (gw - m1 - xhat * m2)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _specs(rows, dim, r_blk):
+    x_spec = pl.BlockSpec((r_blk, dim), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((dim,), lambda i: (0,), memory_space=pltpu.VMEM)
+    return x_spec, w_spec
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layer_norm_p(x2d, weight, bias, eps):
+    rows, dim = x2d.shape
+    r_blk = _pick_r_blk(rows, dim)
+    x_spec, w_spec = _specs(rows, dim, r_blk)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(rows // r_blk,),
+        in_specs=[x_spec, w_spec, w_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, dim), x2d.dtype),
+        interpret=pallas_interpret(),
+    )(x2d, weight, bias)
+
+
+def _ln_fwd(x2d, weight, bias, eps):
+    return _layer_norm_p(x2d, weight, bias, eps), (x2d, weight)
+
+
+def _ln_bwd(eps, residuals, g):
+    x2d, weight = residuals
+    rows, dim = x2d.shape
+    r_blk = _pick_r_blk(rows, dim)
+    x_spec, w_spec = _specs(rows, dim, r_blk)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps),
+        grid=(rows // r_blk,),
+        in_specs=[x_spec, x_spec, w_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, dim), x2d.dtype),
+        interpret=pallas_interpret(),
+    )(g, x2d, weight)
+    # dgamma/dbeta: column reductions over all rows, fp32 accumulate (XLA).
+    x32 = x2d.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    xc = x32 - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    xhat = xc * jax.lax.rsqrt(var + eps)
+    g32 = g.astype(jnp.float32)
+    dw = jnp.sum(g32 * xhat, axis=0).astype(weight.dtype)
+    db = jnp.sum(g32, axis=0).astype(weight.dtype)
+    return dx, dw, db
+
+
+_layer_norm_p.defvjp(_ln_fwd, _ln_bwd)
+
+
+def layer_norm(x, weight, bias, eps=1e-5):
+    """Entry point matching ``ops.layer_norm`` (affine required)."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    out = _layer_norm_p(x2d, weight, bias, float(eps))
+    return out.reshape(shape)

@@ -1,0 +1,42 @@
+"""Counter-based in-kernel PRNG (pure jnp ops).
+
+The CUDA kernels use philox seeded from the torch generator
+(``csrc/softmax_dropout/softmax_dropout_kernel.cu:60-69``); the TPU-native
+equivalent is a stateless counter hash: each element's linear index is mixed
+with the step seed through a splitmix32-style avalanche.  Pure uint32
+vector ops — runs on the VPU, identical results in compiled and interpret
+mode (unlike ``pltpu.prng_random_bits``, which the CPU interpreter doesn't
+emulate), and trivially reproducible between forward and backward, which is
+what lets the backward *recompute* the dropout mask instead of storing it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _mix(h):
+    # splitmix32 finalizer (public-domain constants)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x21F0AAAD)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x735A2D97)
+    h = h ^ (h >> 15)
+    return h
+
+
+def random_bits(seed, shape):
+    """uint32 random bits of ``shape``; ``seed`` is a traced int32/uint32
+    scalar.  Elements are decorrelated by linear index."""
+    idx = jnp.zeros(shape, dtype=jnp.uint32)
+    stride = 1
+    for d in range(len(shape) - 1, -1, -1):
+        idx = idx + jax.lax.broadcasted_iota(jnp.uint32, shape, d) * jnp.uint32(stride)
+        stride *= shape[d]
+    h = idx + seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    return _mix(h)
+
+
+def keep_mask(seed, shape, keep_prob):
+    """Boolean keep-mask with P(keep) = keep_prob."""
+    thresh = jnp.uint32(min(int(keep_prob * 4294967296.0), 4294967295))
+    return random_bits(seed, shape) < thresh

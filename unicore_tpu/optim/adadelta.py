@@ -1,0 +1,60 @@
+"""Adadelta (parity: ``unicore/optim/adadelta.py:13`` wrapping
+``torch.optim.Adadelta``; same update rule, functional form)."""
+
+import jax
+import jax.numpy as jnp
+
+from . import register_optimizer
+from .unicore_optimizer import UnicoreOptimizer
+
+
+@register_optimizer("adadelta")
+class Adadelta(UnicoreOptimizer):
+    def __init__(self, args):
+        super().__init__(args)
+        self.rho = float(getattr(args, "adadelta_rho", 0.9))
+        self.eps = float(getattr(args, "adadelta_eps", 1e-6))
+        self.weight_decay = float(getattr(args, "weight_decay", 0.0))
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument('--adadelta-rho', type=float, default=0.9, metavar='RHO',
+                            help='coefficient used for computing a running average')
+        parser.add_argument('--adadelta-eps', type=float, default=1e-6, metavar='EPS',
+                            help='term added to the denominator')
+        parser.add_argument('--weight-decay', '--wd', default=0.0, type=float,
+                            metavar='WD', help='weight decay')
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "square_avg": jax.tree_util.tree_map(zeros, params),
+            "acc_delta": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(self, grads, state, params, *, lr):
+        rho, eps, wd = self.rho, self.eps, self.weight_decay
+        step = state["step"] + 1
+
+        def upd(g, sq, acc, p):
+            g = g.astype(jnp.float32)
+            if wd != 0.0:
+                g = g + wd * p.astype(jnp.float32)
+            sq = rho * sq + (1 - rho) * g * g
+            delta = jnp.sqrt(acc + eps) / jnp.sqrt(sq + eps) * g
+            acc = rho * acc + (1 - rho) * delta * delta
+            return -lr * delta, sq, acc
+
+        flat = jax.tree_util.tree_map(
+            upd, grads, state["square_avg"], state["acc_delta"], params
+        )
+        is_t = lambda t: isinstance(t, tuple)
+        return (
+            jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=is_t),
+            {
+                "step": step,
+                "square_avg": jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=is_t),
+                "acc_delta": jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=is_t),
+            },
+        )

@@ -1,0 +1,45 @@
+"""Adagrad (parity: ``unicore/optim/adagrad.py:13`` wrapping
+``torch.optim.Adagrad``; same update rule, functional form)."""
+
+import jax
+import jax.numpy as jnp
+
+from . import register_optimizer
+from .unicore_optimizer import UnicoreOptimizer
+
+
+@register_optimizer("adagrad")
+class Adagrad(UnicoreOptimizer):
+    def __init__(self, args):
+        super().__init__(args)
+        self.weight_decay = float(getattr(args, "weight_decay", 0.0))
+        self.eps = 1e-10  # torch Adagrad default
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument('--weight-decay', '--wd', default=0.0, type=float,
+                            metavar='WD', help='weight decay')
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "sum": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(self, grads, state, params, *, lr):
+        wd, eps = self.weight_decay, self.eps
+        step = state["step"] + 1
+
+        def upd(g, s, p):
+            g = g.astype(jnp.float32)
+            if wd != 0.0:
+                g = g + wd * p.astype(jnp.float32)
+            s = s + g * g
+            return -lr * g / (jnp.sqrt(s) + eps), s
+
+        flat = jax.tree_util.tree_map(upd, grads, state["sum"], params)
+        is_t = lambda t: isinstance(t, tuple)
+        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=is_t)
+        sums = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=is_t)
+        return updates, {"step": step, "sum": sums}

@@ -1,0 +1,115 @@
+"""Dynamic loss scaling.
+
+Parity target: ``unicore/optim/dynamic_loss_scaler.py:8-71`` — grow x2 every
+``scale_window`` clean steps, shrink x2 on overflow subject to a tolerance
+fraction, abort below ``min_loss_scale``.
+
+Two forms:
+
+- ``DynamicLossScaler``: host-side class, behaviorally equivalent to the
+  reference (raises OverflowError on overflow / FloatingPointError at the
+  floor so the trainer's skip/abort control flow matches).
+- ``scaler_init`` / ``scaler_effective_scale`` / ``scaler_update``:
+  functional jnp version whose state lives *inside* the jitted train step,
+  so the overflow-skip needs no host round-trip (the TPU-idiomatic
+  replacement for the reference's exception-driven flow — SURVEY §7).
+  The floor abort is checked host-side when stats are read.
+"""
+
+import jax.numpy as jnp
+
+
+class DynamicLossScaler:
+    def __init__(
+        self,
+        init_scale=2.0 ** 15,
+        scale_factor=2.0,
+        scale_window=2000,
+        tolerance=0.0,
+        threshold=None,
+        min_loss_scale=1e-4,
+    ):
+        self.loss_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.tolerance = tolerance
+        self.threshold = threshold
+        self._iter = 0
+        self._last_overflow_iter = -1
+        self._last_rescale_iter = -1
+        self._overflows_since_rescale = 0
+        self.min_loss_scale = min_loss_scale
+
+    def scale(self, outputs):
+        return self.loss_scale * outputs
+
+    def update(self):
+        if (self._iter - self._last_overflow_iter) % self.scale_window == 0:
+            self.loss_scale *= self.scale_factor
+            self._last_rescale_iter = self._iter
+        self._iter += 1
+
+    def _decrease_loss_scale(self):
+        self.loss_scale /= self.scale_factor
+        if self.threshold is not None:
+            self.loss_scale = max(self.loss_scale, self.threshold)
+
+    def check_overflow(self, grad_norm):
+        if grad_norm == float("inf") or grad_norm != grad_norm:
+            prev_scale = self.loss_scale
+            iter_since_rescale = self._iter - self._last_rescale_iter
+            self._last_overflow_iter = self._iter
+            self._overflows_since_rescale += 1
+            pct_overflow = self._overflows_since_rescale / float(iter_since_rescale)
+            if pct_overflow >= self.tolerance:
+                self._decrease_loss_scale()
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
+            if self.loss_scale <= self.min_loss_scale:
+                self.loss_scale = prev_scale
+                raise FloatingPointError(
+                    (
+                        "Minimum loss scale reached ({}). Your loss is probably "
+                        "exploding. Try lowering the learning rate, using gradient "
+                        "clipping or increasing the batch size."
+                    ).format(self.min_loss_scale)
+                )
+            self._iter += 1
+            raise OverflowError("setting loss scale to: " + str(self.loss_scale))
+
+    def state_dict(self):
+        return {"loss_scale": self.loss_scale}
+
+    def load_state_dict(self, state_dict):
+        if "loss_scale" in state_dict:
+            self.loss_scale = state_dict["loss_scale"]
+
+
+# ---------------------------------------------------------------------------
+# Functional (in-jit) scaler
+# ---------------------------------------------------------------------------
+
+
+def scaler_init(init_scale=2.0 ** 15, enabled=True):
+    """Scaler state as a pytree of device scalars (lives in TrainState)."""
+    return {
+        "scale": jnp.asarray(init_scale if enabled else 1.0, dtype=jnp.float32),
+        "growth_tracker": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+def scaler_update(state, overflow, scale_window, scale_factor=2.0,
+                  min_scale=1e-4, max_scale=2.0 ** 24):
+    """Pure update: shrink on overflow, grow after scale_window clean steps.
+
+    ``overflow`` is a traced bool.  (The reference's tolerance fraction is
+    host-side bookkeeping; tolerance=0 — its default — is exact here.)
+    """
+    tracker = jnp.where(overflow, 0, state["growth_tracker"] + 1)
+    grow = tracker >= scale_window
+    scale = state["scale"]
+    scale = jnp.where(overflow, scale / scale_factor, scale)
+    scale = jnp.where(grow, scale * scale_factor, scale)
+    scale = jnp.clip(scale, min_scale, max_scale)
+    tracker = jnp.where(grow, 0, tracker)
+    return {"scale": scale, "growth_tracker": tracker}

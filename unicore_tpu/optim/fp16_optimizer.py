@@ -1,0 +1,60 @@
+"""Mixed-precision machinery.
+
+Parity target: ``unicore/optim/fp16_optimizer.py`` — bf16/fp16 model params
+with a fp32 master copy, loss scaling (fp16 only; bf16 disables the scaler,
+``:266-276``), and optional stochastic rounding on the master->model sync
+(``--bf16-sr``, ``:146-148``).
+
+TPU-native redesign: the reference flattens params into one contiguous
+slab per dtype (``flatten_fp16_parameters``, ``:48-83``) because eager torch
+pays per-tensor kernel-launch and allreduce overheads.  Under XLA there are
+no per-tensor launches — the whole master-copy update is one fused program —
+so the master copy stays a *pytree* of fp32 leaves, which also keeps
+checkpoints sharding-friendly.  The flat-slab trick is therefore
+intentionally absent (its motivation doesn't exist on TPU).
+
+Responsibility split (SURVEY §7): the scaler state and master params live in
+the trainer's TrainState; this module provides the pure functions the jitted
+step composes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import ops
+
+
+def make_master_params(params):
+    """fp32 master copy of a (possibly bf16/fp16) param pytree
+    (reference ``build_fp32_params``, fp16_optimizer.py:34-46)."""
+    return jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+
+
+def sync_master_to_model(master, model_dtype, sr_rng=None):
+    """Cast the fp32 master copy to the model dtype, optionally with
+    stochastic rounding (reference ``_sync_fp32_params_to_fp16``,
+    fp16_optimizer.py:140-150)."""
+    if model_dtype == jnp.float32:
+        return master
+    if sr_rng is not None and model_dtype == jnp.bfloat16:
+        leaves, treedef = jax.tree_util.tree_flatten(master)
+        keys = jax.random.split(sr_rng, len(leaves))
+        out = [ops.fp32_to_bf16_sr(l, k) for l, k in zip(leaves, keys)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return jax.tree_util.tree_map(lambda p: p.astype(model_dtype), master)
+
+
+def grads_finite(grads):
+    """Global all-finite check over a grad pytree (the analogue of the
+    reference's inf/nan grad-norm overflow test, fp16_optimizer.py:189-206)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    ok = jnp.asarray(True)
+    for g in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def default_scale_window(world_size, update_freq):
+    """Reference default: ``2**14 / world_size / update_freq``
+    (fp16_optimizer.py:255-264)."""
+    return max(int(2 ** 14 / world_size / update_freq), 1)

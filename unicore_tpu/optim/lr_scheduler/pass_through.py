@@ -1,0 +1,28 @@
+"""Pass-through schedule delegating to an optimizer-owned scheduler
+(parity: lr_scheduler/pass_through.py)."""
+
+from . import register_lr_scheduler
+from .unicore_lr_scheduler import UnicoreLRScheduler
+
+
+@register_lr_scheduler("pass_through")
+class PassThroughScheduleSchedule(UnicoreLRScheduler):
+    """Delegate lr scheduling to the optimizer."""
+
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        assert (
+            getattr(optimizer, "lr_scheduler", None) is not None
+        ), "Pass-through schedule can only be used with optimizers with their own schedulers"
+
+    def state_dict(self):
+        return self.optimizer.lr_scheduler.state_dict()
+
+    def load_state_dict(self, state_dict):
+        self.optimizer.lr_scheduler.load_state_dict(state_dict)
+
+    def step_begin_epoch(self, epoch):
+        return self.optimizer.lr_scheduler.step_begin_epoch(epoch)
+
+    def step_update(self, num_updates):
+        return self.optimizer.lr_scheduler.step_update(num_updates)

@@ -1,0 +1,93 @@
+"""Tri-stage (warmup/hold/decay) LR schedule (parity:
+lr_scheduler/tri_stage_lr_scheduler.py; SpecAugment, arxiv 1904.08779)."""
+
+import math
+
+from . import register_lr_scheduler
+from .unicore_lr_scheduler import UnicoreLRScheduler
+
+
+@register_lr_scheduler("tri_stage")
+class TriStageLRSchedule(UnicoreLRScheduler):
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if len(args.lr) > 1:
+            raise ValueError(
+                "Cannot use a fixed learning rate schedule with tri-stage lr;"
+                " consider --lr-scheduler=fixed instead."
+            )
+        self.peak_lr = args.lr[0]
+        self.init_lr = args.init_lr_scale * args.lr[0]
+        self.final_lr = args.final_lr_scale * args.lr[0]
+        if args.phase_ratio is not None:
+            assert args.max_update > 0
+            phase_ratio = (
+                eval(args.phase_ratio)
+                if isinstance(args.phase_ratio, str)
+                else args.phase_ratio
+            )
+            assert sum(phase_ratio) == 1, "phase ratios must add up to 1"
+            self.warmup_steps = int(args.max_update * phase_ratio[0])
+            self.hold_steps = int(args.max_update * phase_ratio[1])
+            self.decay_steps = int(args.max_update * phase_ratio[2])
+        else:
+            self.warmup_steps = args.warmup_steps
+            self.hold_steps = args.hold_steps
+            self.decay_steps = args.decay_steps
+        assert (
+            self.warmup_steps + self.hold_steps + self.decay_steps > 0
+        ), "please specify steps or phase_ratio"
+        self.warmup_rate = (
+            (self.peak_lr - self.init_lr) / self.warmup_steps
+            if self.warmup_steps != 0
+            else 0
+        )
+        self.decay_factor = -math.log(args.final_lr_scale) / self.decay_steps
+        self.lr = self.init_lr
+        self.optimizer.set_lr(self.lr)
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument('--warmup-steps', default=4000, type=int, metavar='N',
+                            help='warmup the learning rate linearly for the first N updates')
+        parser.add_argument('--hold-steps', default=20000, type=int, metavar='N',
+                            help='steps in hold stage')
+        parser.add_argument('--decay-steps', default=60000, type=int, metavar='N',
+                            help='steps in decay stage')
+        parser.add_argument('--phase-ratio', default=None,
+                            help='ratio for all stages, e.g. "(0.1, 0.4, 0.5)"')
+        parser.add_argument('--init-lr-scale', default=0.01, type=float,
+                            help='initial learning rate scale during warmup phase')
+        parser.add_argument('--final-lr-scale', default=0.01, type=float,
+                            help='final learning rate scale')
+
+    def _decide_stage(self, update_step):
+        if update_step < self.warmup_steps:
+            return 0, update_step
+        offset = self.warmup_steps
+        if update_step < offset + self.hold_steps:
+            return 1, update_step - offset
+        offset += self.hold_steps
+        if update_step <= offset + self.decay_steps:
+            return 2, update_step - offset
+        offset += self.decay_steps
+        return 3, update_step - offset
+
+    def step(self, epoch, val_loss=None):
+        super().step(epoch, val_loss)
+        return self.optimizer.get_lr()
+
+    def step_update(self, num_updates):
+        stage, steps_in_stage = self._decide_stage(num_updates)
+        if stage == 0:
+            self.lr = self.init_lr + self.warmup_rate * steps_in_stage
+        elif stage == 1:
+            self.lr = self.peak_lr
+        elif stage == 2:
+            self.lr = self.peak_lr * math.exp(-self.decay_factor * steps_in_stage)
+        elif stage == 3:
+            self.lr = self.final_lr
+        else:
+            raise ValueError("Undefined stage")
+        self.optimizer.set_lr(self.lr)
+        return self.lr

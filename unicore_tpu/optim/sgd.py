@@ -1,0 +1,57 @@
+"""SGD with momentum (parity: ``unicore/optim/sgd.py:13`` wrapping
+``torch.optim.SGD``; same update rule, functional form)."""
+
+import jax
+import jax.numpy as jnp
+
+from . import register_optimizer
+from .unicore_optimizer import UnicoreOptimizer
+
+
+@register_optimizer("sgd")
+class SGD(UnicoreOptimizer):
+    def __init__(self, args):
+        super().__init__(args)
+        self.momentum = float(getattr(args, "momentum", 0.0))
+        self.weight_decay = float(getattr(args, "weight_decay", 0.0))
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument('--momentum', default=0.0, type=float, metavar='M',
+                            help='momentum factor')
+        parser.add_argument('--weight-decay', '--wd', default=0.0, type=float,
+                            metavar='WD', help='weight decay')
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return {"step": jnp.zeros((), dtype=jnp.int32)}
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "momentum_buffer": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(self, grads, state, params, *, lr):
+        wd, mom = self.weight_decay, self.momentum
+        step = state["step"] + 1
+
+        def eff_grad(g, p):
+            g = g.astype(jnp.float32)
+            if wd != 0.0:
+                # torch SGD: L2 regularization folded into the gradient
+                g = g + wd * p.astype(jnp.float32)
+            return g
+
+        gs = jax.tree_util.tree_map(eff_grad, grads, params)
+        if mom == 0.0:
+            updates = jax.tree_util.tree_map(lambda g: -lr * g, gs)
+            return updates, {"step": step}
+        bufs = jax.tree_util.tree_map(
+            lambda b, g: mom * b + g, state["momentum_buffer"], gs
+        )
+        updates = jax.tree_util.tree_map(lambda b: -lr * b, bufs)
+        return updates, {"step": step, "momentum_buffer": bufs}
+
+    @property
+    def supports_flat_params(self):
+        return True

@@ -21,6 +21,18 @@ from argparse import Namespace
 class UnicoreOptimizer:
     def __init__(self, args: Namespace):
         self.args = args
+        lr = getattr(args, "lr", 0.0)
+        self._lr = float(lr[0]) if isinstance(lr, (list, tuple)) else float(lr)
+
+    # -- host-side lr mirror (the scheduler <-> trainer contract;
+    #    reference unicore_optimizer.py:92-95) --------------------------------
+
+    def get_lr(self):
+        """Current learning rate (python float, fed into the jitted step)."""
+        return self._lr
+
+    def set_lr(self, lr):
+        self._lr = float(lr)
 
     @classmethod
     def add_args(cls, parser):

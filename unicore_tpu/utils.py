@@ -294,3 +294,22 @@ def warn_once(msg, _seen=set()):
     if msg not in _seen:
         _seen.add(msg)
         warnings.warn(msg)
+
+
+def get_activation_fn(activation):
+    """Activation by name (reference: unicore/utils.py:166-178)."""
+    import jax
+    import jax.numpy as jnp
+
+    fns = {
+        # torch F.gelu is the exact (erf) variant
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+        "relu": jax.nn.relu,
+        "tanh": jnp.tanh,
+        "silu": jax.nn.silu,
+        "linear": lambda x: x,
+    }
+    if activation not in fns:
+        raise RuntimeError(f"--activation-fn {activation} not supported")
+    return fns[activation]
