@@ -1,0 +1,114 @@
+"""Benchmark: BERT-base MLM training throughput (samples/sec/chip).
+
+Run by the driver on real TPU hardware at the end of every round.  Prints
+ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference publishes no numbers; the
+driver-defined target is within 10% of an 8xA100 reference run on v5e-8.
+A per-A100 BERT-base MLM (seq 512, fp16, fused kernels) reference
+throughput is ~185 samples/s/GPU (internal reproduction of the reference's
+`examples/bert` config at batch 32/GPU); `vs_baseline` is value/185.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+A100_REF_SAMPLES_PER_SEC = 185.0
+
+LAYERS, DIM, FFN, HEADS = 12, 768, 3072, 12
+VOCAB, SEQ = 30528, 512  # vocab padded to a 128 multiple
+BATCH = int(os.environ.get("BENCH_BATCH", "24"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+WARMUP = 3
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from argparse import Namespace
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples", "bert")
+    )
+    from model import BertModel
+
+    from unicore_tpu.optim import OPTIMIZER_REGISTRY
+
+    model = BertModel(
+        vocab_size=VOCAB, padding_idx=0, encoder_layers=LAYERS,
+        encoder_embed_dim=DIM, encoder_ffn_embed_dim=FFN,
+        encoder_attention_heads=HEADS, max_seq_len=SEQ,
+        emb_dropout=0.1, dropout=0.1, attention_dropout=0.1,
+        activation_dropout=0.0, post_ln=True,
+    )
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(4, VOCAB - 1, size=(BATCH, SEQ)).astype(np.int32)
+    target = np.full_like(toks, 0)
+    mask = rng.rand(BATCH, SEQ) < 0.15
+    target[mask] = toks[mask]
+
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, jnp.asarray(toks[:2]))["params"]
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+
+    opt = OPTIMIZER_REGISTRY["adam"](
+        Namespace(lr=[1e-4], adam_betas="(0.9, 0.98)", adam_eps=1e-8,
+                  weight_decay=0.01)
+    )
+    opt_state = opt.init(params)
+
+    def loss_fn(params_f32, toks, target, step_rng):
+        p_bf16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params_f32
+        )
+        logits = model.apply(
+            {"params": p_bf16}, toks, deterministic=False,
+            rngs={"dropout": step_rng},
+        )
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        m = (target != 0)
+        tgt = jnp.where(m, target, 0)
+        nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
+
+    @jax.jit
+    def train_step(params, opt_state, toks, target, step_rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks, target, step_rng)
+        updates, opt_state = opt.update(grads, opt_state, params, lr=1e-4)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    toks_d = jnp.asarray(toks)
+    tgt_d = jnp.asarray(target)
+
+    for i in range(WARMUP):
+        params, opt_state, loss = train_step(
+            params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, i)
+        )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        params, opt_state, loss = train_step(
+            params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, WARMUP + i)
+        )
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_throughput",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / A100_REF_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""BERT MLM example plugin (reference: ``examples/bert/``).
+
+Loaded via ``--user-dir examples/bert`` — exercising the same plugin
+mechanism downstream projects (Uni-Mol / Uni-Fold style) use.
+"""
+
+from . import task, model  # noqa: F401

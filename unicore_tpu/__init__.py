@@ -14,6 +14,8 @@ __version__ = "0.1.0"
 # jax-free at import time, so preprocessing boxes don't pay jax init cost.
 # `unicore_tpu.models` / `unicore_tpu.modules` import jax+flax and are pulled
 # in lazily by options.parse_args_and_arch / the CLI.
+from unicore_tpu.logging import meters, metrics, progress_bar  # noqa
+
 import unicore_tpu.data  # noqa
 import unicore_tpu.losses  # noqa
 import unicore_tpu.optim  # noqa

@@ -1,0 +1,23 @@
+"""Distributed runtime (parity surface: ``unicore/distributed/``).
+
+The reference is imperative per-rank SPMD: one spawned process per GPU,
+NCCL process groups, explicit collectives, DDP wrapper objects
+(``unicore/distributed/utils.py``, ``legacy_distributed_data_parallel.py``).
+
+The TPU-native replacement is single-program SPMD (SURVEY §5.8): one python
+process per *host*, a ``jax.sharding.Mesh`` over all devices, shardings
+declared on the jitted train step, collectives emitted by XLA over ICI/DCN.
+The DDP wrapper disappears as an object; ``all_reduce``-style helpers exist
+only for host-side control-plane data.
+"""
+
+from .utils import (  # noqa: F401
+    call_main,
+    data_sharding,
+    distributed_init,
+    get_data_parallel_rank,
+    get_data_parallel_world_size,
+    get_mesh,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,132 @@
+"""Mesh construction, multi-host init, and sharding helpers.
+
+Replaces the reference's rendezvous + process-group machinery
+(``unicore/distributed/utils.py:32-263``):
+
+- ``distributed_init`` -> ``jax.distributed.initialize`` (env:// and Slurm
+  autodetection are handled by jax itself; the reference's
+  ``infer_init_method`` trichotomy collapses into this one call).
+- process spawning (``torch.multiprocessing.spawn``) disappears: jax runs
+  one process per host and addresses all local devices.
+- process groups -> named mesh axes.  The reference's "data-parallel group
+  == global group" fact (``utils.py:251-263``) maps to the default mesh
+  being 1-D over the ``data`` axis; tensor/sequence/pipeline axes are new
+  capability, configured by ``--tensor-parallel-size`` etc.
+"""
+
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MESH = None
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def distributed_init(args=None):
+    """Initialize multi-host jax if a cluster environment is detected.
+
+    Safe to call when single-host (no-op).  Env contracts: jax's own
+    auto-detection covers Slurm/OpenMPI/TPU pods; explicit
+    ``--distributed-init-method`` / ``--distributed-world-size`` /
+    ``--distributed-rank`` args force coordinator-based init (the analogue
+    of the reference's env:// rendezvous)."""
+    jax = _jax()
+    coord = getattr(args, "distributed_init_method", None) if args else None
+    if coord and coord.startswith("env://"):
+        coord = None  # fall through to auto-detection
+    try:
+        if coord:
+            jax.distributed.initialize(
+                coordinator_address=coord.replace("tcp://", ""),
+                num_processes=getattr(args, "distributed_world_size", None),
+                process_id=getattr(args, "distributed_rank", None),
+            )
+        elif (
+            "SLURM_JOB_ID" in os.environ
+            or "COORDINATOR_ADDRESS" in os.environ
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        ):
+            jax.distributed.initialize()
+    except Exception as e:  # already initialized or single-host
+        logger.debug("jax.distributed.initialize skipped: %s", e)
+    return jax.process_index()
+
+
+def get_data_parallel_rank():
+    return _jax().process_index()
+
+
+def get_data_parallel_world_size():
+    return _jax().process_count()
+
+
+def get_mesh(args=None, devices=None):
+    """Build (and cache) the global device mesh.
+
+    Axes: ``(data, fsdp, tensor, seq)``.  Defaults put every device on the
+    ``data`` axis (the reference's only strategy); the other axes are sized
+    by args and consume devices from the data axis."""
+    global _MESH
+    jax = _jax()
+    if devices is None and _MESH is not None and args is None:
+        return _MESH
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    tp = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1
+    sp = int(getattr(args, "seq_parallel_size", 1) or 1) if args else 1
+    fsdp = int(getattr(args, "fsdp_size", 1) or 1) if args else 1
+    assert n % (tp * sp * fsdp) == 0, (
+        f"devices ({n}) not divisible by tp*sp*fsdp ({tp}*{sp}*{fsdp})"
+    )
+    dp = n // (tp * sp * fsdp)
+    mesh_devices = np.asarray(devices).reshape(dp, fsdp, sp, tp)
+    mesh = jax.sharding.Mesh(mesh_devices, ("data", "fsdp", "seq", "tensor"))
+    if args is None or (tp == 1 and sp == 1 and fsdp == 1):
+        _MESH = mesh
+    return mesh
+
+
+def replicated(mesh):
+    """Fully-replicated sharding (params, optimizer state under pure DP)."""
+    jax = _jax()
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def data_sharding(mesh, ndim=None):
+    """Batch sharding: leading dim split over (data, fsdp) — batch rides both
+    axes since fsdp shards the batch too (ZeRO-style)."""
+    jax = _jax()
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(("data", "fsdp"))
+    )
+
+
+def shard_batch(batch, mesh):
+    """Device-put a host batch pytree with the data sharding."""
+    jax = _jax()
+    sharding = data_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch
+    )
+
+
+def call_main(args, main, **kwargs):
+    """Single-program entry (parity: ``distributed_utils.call_main``,
+    utils.py:170).  No process spawning: jax addresses all local devices
+    from one process; multi-host launch is one process per host, each
+    calling this."""
+    distributed_init(args)
+    rank = get_data_parallel_rank()
+    if rank != 0:
+        # non-master ranks log at WARNING (reference utils.py:142-145)
+        logging.getLogger("unicore_tpu").setLevel(logging.WARNING)
+    args.distributed_rank = rank
+    return main(args, **kwargs)

@@ -1,0 +1,48 @@
+"""Cross-entropy loss (parity: ``unicore/losses/cross_entropy.py``)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import metrics
+from unicore_tpu.losses import UnicoreLoss, register_loss
+
+
+@register_loss("cross_entropy")
+class CrossEntropyLoss(UnicoreLoss):
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        net_output = model.apply(
+            {"params": params},
+            **sample["net_input"],
+            deterministic=not is_training,
+            rngs={"dropout": rng} if (is_training and rng is not None) else None,
+        )
+        loss = self.compute_loss(net_output, sample)
+        bsz = sample["target"].shape[0]
+        sample_size = jnp.asarray(bsz, dtype=jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "bsz": jnp.asarray(bsz, dtype=jnp.float32),
+            "sample_size": sample_size,
+        }
+        return loss, sample_size, logging_output
+
+    def compute_loss(self, net_output, sample):
+        lprobs = jax.nn.log_softmax(net_output.astype(jnp.float32), axis=-1)
+        lprobs = lprobs.reshape(-1, lprobs.shape[-1])
+        target = sample["target"].reshape(-1)
+        # nll with sum reduction
+        return -jnp.sum(jnp.take_along_axis(lprobs, target[:, None], axis=-1))
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="valid") -> None:
+        loss_sum = sum(float(log.get("loss", 0)) for log in logging_outputs)
+        sample_size = sum(float(log.get("sample_size", 0)) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / math.log(2), sample_size, round=3
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,66 @@
+"""Masked-LM loss (parity: ``unicore/losses/masked_lm.py``).
+
+The reference gathers the masked positions with a dynamic boolean index
+(``target[masked_tokens]``) — a dynamic shape jit cannot trace.  The
+TPU-native form is the weighted full-sequence loss: every position computes
+its nll, masked by ``target != pad``; identical sums, static shapes
+(SURVEY §7 "hard parts").  The model still receives ``masked_tokens`` so it
+can cheapen the vocab projection with a fixed-capacity gather if it wants.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import metrics
+from unicore_tpu.losses import UnicoreLoss, register_loss
+
+
+@register_loss("masked_lm")
+class MaskedLMLoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        target = sample["target"]
+        masked_tokens = target != self.padding_idx  # [B, T] bool, static shape
+        sample_size = jnp.sum(masked_tokens.astype(jnp.float32))
+
+        logits = model.apply(
+            {"params": params},
+            **sample["net_input"],
+            masked_tokens=masked_tokens,
+            deterministic=not is_training,
+            rngs={"dropout": rng} if (is_training and rng is not None) else None,
+        )
+        # logits: [B, T, V] (full-sequence head; weighted-mask loss)
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = jnp.where(masked_tokens, target, 0)
+        nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(nll * masked_tokens.astype(nll.dtype))
+
+        bsz, seq_len = target.shape[0], target.shape[1]
+        logging_output = {
+            "loss": loss,
+            "bsz": jnp.asarray(bsz, dtype=jnp.float32),
+            "sample_size": sample_size,
+            "seq_len": jnp.asarray(seq_len * bsz, dtype=jnp.float32),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="valid") -> None:
+        loss_sum = sum(float(log.get("loss", 0)) for log in logging_outputs)
+        bsz = sum(float(log.get("bsz", 0)) for log in logging_outputs)
+        sample_size = sum(float(log.get("sample_size", 0)) for log in logging_outputs)
+        seq_len = sum(float(log.get("seq_len", 0)) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / math.log(2), sample_size, round=3
+        )
+        metrics.log_scalar("seq_len", seq_len / bsz, 1, round=3)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -6,9 +6,10 @@ to the fp32 bit pattern, then truncate the mantissa (round-toward-zero into
 bf16).  Used when syncing the fp32 master copy back to bf16 params under
 ``--bf16-sr`` (``unicore/optim/fp16_optimizer.py:146-148``).
 
-This is pure bit manipulation — XLA compiles it to a handful of vector ops,
-so the jnp implementation *is* the fast path; no Pallas kernel is needed
-(``threefry``/TPU PRNG supplies the bits).
+The jnp reference uses ``jax.random.bits`` (threefry); the Pallas kernel
+(``ops/pallas/rounding.py``) uses the counter-hash PRNG and tiles through
+VMEM — same rounding math, different random streams.  ``use_pallas()``
+selects between them.
 """
 
 import jax

@@ -87,6 +87,13 @@ def softmax_dropout(
 def _pallas_eligible(x, mask, bias):
     # Lane-dim constraint: the kernel tiles the softmax axis into VMEM; keep
     # to 128-multiples and bounded row length (mirrors the reference kernel's
-    # k <= 2048 warp/block split, softmax_fast.h:470-508).
+    # k <= 2048 warp/block split, softmax_fast.h:470-508).  Operands
+    # broadcast over the k axis are NOT supported by the kernel's BlockSpec
+    # layout (full-k blocks) — those fall back to the jnp reference.
     k = x.shape[-1]
-    return k % 128 == 0 and k <= 8192 and x.ndim >= 2
+    if not (k % 128 == 0 and k <= 8192 and x.ndim >= 2):
+        return False
+    for op in (mask, bias):
+        if op is not None and op.shape[-1] != k:
+            return False
+    return True

@@ -313,3 +313,18 @@ def get_activation_fn(activation):
     if activation not in fns:
         raise RuntimeError(f"--activation-fn {activation} not supported")
     return fns[activation]
+
+
+def tree_map_arrays(fn, tree):
+    """Map ``fn`` over array leaves (numpy / jax / scalars with shape),
+    passing other leaves through unchanged."""
+    import numpy as _np
+
+    jax = _jax()
+
+    def _apply(x):
+        if hasattr(x, "shape") or isinstance(x, (_np.generic, int, float)):
+            return fn(x)
+        return x
+
+    return jax.tree_util.tree_map(_apply, tree)
