@@ -91,15 +91,19 @@ def main():
         params, opt_state, loss = train_step(
             params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, i)
         )
-    jax.block_until_ready(loss)
+    # device_get of the final chained loss forces the whole dependency chain
+    # to execute (block_until_ready alone does not synchronize through the
+    # axon relay on this dev setup)
+    float(jax.device_get(loss))
 
     t0 = time.perf_counter()
     for i in range(STEPS):
         params, opt_state, loss = train_step(
             params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, WARMUP + i)
         )
-    jax.block_until_ready(loss)
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     samples_per_sec = BATCH * STEPS / dt
     print(json.dumps({

@@ -91,16 +91,22 @@ class BertTask(UnicoreTask):
         with data_utils.numpy_seed(self.args.seed):
             shuffle = np.random.permutation(len(src_dataset))
 
+        # pad to the fixed max_seq_len: static shapes are what keep one jit
+        # compile for the whole run (SURVEY §7 "pad-to-fixed-bucket shapes")
         self.datasets[split] = SortDataset(
             NestedDictionaryDataset(
                 {
                     "net_input": {
                         "src_tokens": RightPadDataset(
-                            src_dataset, pad_idx=self.dictionary.pad()
+                            src_dataset,
+                            pad_idx=self.dictionary.pad(),
+                            pad_to_length=self.args.max_seq_len,
                         )
                     },
                     "target": RightPadDataset(
-                        tgt_dataset, pad_idx=self.dictionary.pad()
+                        tgt_dataset,
+                        pad_idx=self.dictionary.pad(),
+                        pad_to_length=self.args.max_seq_len,
                     ),
                 },
             ),

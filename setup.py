@@ -1,0 +1,43 @@
+"""Packaging (parity target: reference setup.py:1-254 — minus the CUDA
+extension build matrix, which has no TPU analogue: the Pallas kernels
+compile at trace time via XLA/Mosaic, so the wheel is pure python)."""
+
+import os
+
+from setuptools import find_packages, setup
+
+
+def read_version():
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "unicore_tpu", "__init__.py")) as f:
+        for line in f:
+            if line.startswith("__version__"):
+                return line.split("=")[1].strip().strip('"').strip("'")
+    return "0.0.0"
+
+
+setup(
+    name="unicore-tpu",
+    version=read_version(),
+    description="TPU-native distributed training framework "
+    "(jax/XLA/Pallas rebuild of the Uni-Core capability surface)",
+    packages=find_packages(
+        exclude=["tests", "tests.*", "examples", "examples.*"]
+    ),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "numpy",
+        "ml_dtypes",
+    ],
+    extras_require={
+        "data": ["lmdb", "tokenizers"],
+        "test": ["pytest", "torch"],
+    },
+    entry_points={
+        "console_scripts": [
+            "unicore-train = unicore_tpu_cli.train:cli_main",
+        ],
+    },
+)

@@ -1,0 +1,137 @@
+"""Flash attention kernel vs materialized reference — fwd, grads (incl.
+bias), padding, causal, dropout statistics, and module-level dispatch
+equivalence.  Runs in interpret mode on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unicore_tpu.ops.backend import kernel_backend
+from unicore_tpu.ops.pallas.flash_attention import eligible, flash_attention
+
+B, T, H, D = 2, 256, 4, 64
+
+
+def ref_attn(q, k, v, bias=None, pad=None, causal=False, scale=None):
+    scale = D ** -0.5 if scale is None else scale
+    qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if bias is not None:
+        s = s + bias
+    if pad is not None:
+        s = jnp.where(pad.astype(bool)[:, None, None, :], -1e30, s)
+    if causal:
+        m = jnp.triu(jnp.full((q.shape[1], k.shape[1]), -1e30), k=1)
+        s = s + m[None, None]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.transpose(jnp.einsum("bhqk,bhkd->bhqd", p, vt), (0, 2, 1, 3))
+
+
+@pytest.fixture
+def qkv(rng):
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("case", ["plain", "bias", "pad", "bias+pad", "causal"])
+def test_flash_forward_parity(rng, qkv, case):
+    q, k, v = qkv
+    kw, refkw = {}, {}
+    if "bias" in case:
+        bias = jnp.asarray(rng.randn(1, H, T, T).astype(np.float32))
+        kw["bias"] = refkw["bias"] = bias
+    if "pad" in case:
+        pad = np.zeros((B, T), dtype=np.int32)
+        pad[:, -32:] = 1
+        kw["key_padding_mask"] = jnp.asarray(pad)
+        refkw["pad"] = jnp.asarray(pad)
+    if case == "causal":
+        kw["causal"] = refkw["causal"] = True
+    out = flash_attention(q, k, v, is_training=False, **kw)
+    ref = ref_attn(q, k, v, **refkw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grad_parity(rng, qkv):
+    q, k, v = qkv
+    bias = jnp.asarray(rng.randn(1, H, T, T).astype(np.float32))
+    pad = np.zeros((B, T), dtype=np.int32)
+    pad[:, -32:] = 1
+    pad = jnp.asarray(pad)
+
+    def lf(q, k, v, bias):
+        return jnp.sum(
+            flash_attention(q, k, v, bias=bias, key_padding_mask=pad,
+                            is_training=False) ** 2
+        )
+
+    def lr(q, k, v, bias):
+        return jnp.sum(ref_attn(q, k, v, bias=bias, pad=pad) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for name, a, b in zip("q k v bias".split(), g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=name
+        )
+
+
+def test_flash_dropout_deterministic_and_distributed(rng, qkv):
+    q, k, v = qkv
+    key = jax.random.PRNGKey(5)
+    o1 = flash_attention(q, k, v, dropout_prob=0.3, rng=key, is_training=True)
+    o2 = flash_attention(q, k, v, dropout_prob=0.3, rng=key, is_training=True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o3 = flash_attention(q, k, v, dropout_prob=0.3, rng=jax.random.PRNGKey(6),
+                         is_training=True)
+    assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 1e-4
+    # dropout changes the output vs no-dropout
+    o4 = flash_attention(q, k, v, is_training=False)
+    assert np.abs(np.asarray(o1) - np.asarray(o4)).max() > 1e-4
+
+
+def test_flash_dropout_grads_finite(rng, qkv):
+    q, k, v = qkv
+    key = jax.random.PRNGKey(0)
+
+    def loss(q):
+        return jnp.sum(
+            flash_attention(q, k, v, dropout_prob=0.2, rng=key,
+                            is_training=True) ** 2
+        )
+
+    g = jax.grad(loss)(q)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_eligibility_rules():
+    assert eligible((2, 4, 256, 64), (2, 4, 256, 64), None)
+    assert eligible((2, 4, 256, 64), (2, 4, 256, 64), (1, 4, 256, 256))
+    # batched bias -> materialized fallback
+    assert not eligible((2, 4, 256, 64), (2, 4, 256, 64), (2, 4, 256, 256))
+    # non-128-multiple seq
+    assert not eligible((2, 4, 200, 64), (2, 4, 200, 64), None)
+
+
+def test_module_dispatch_equivalence(rng):
+    """SelfMultiheadAttention must produce identical results via the flash
+    path (forced pallas backend) and the einsum path."""
+    from unicore_tpu.modules import SelfMultiheadAttention
+
+    E, heads = 64, 2
+    x = jnp.asarray(rng.randn(2, 128, E).astype(np.float32))
+    bias = jnp.asarray(rng.randn(1, heads, 128, 128).astype(np.float32))
+    pad = np.zeros((2, 128), dtype=np.int32)
+    pad[:, -16:] = 1
+    attn = SelfMultiheadAttention(embed_dim=E, num_heads=heads, dropout=0.0)
+    params = attn.init(jax.random.PRNGKey(0), x)
+    with kernel_backend("reference"):
+        o_ref = attn.apply(params, x, key_padding_mask=jnp.asarray(pad),
+                           attn_bias=bias)
+    with kernel_backend("pallas"):
+        o_flash = attn.apply(params, x, key_padding_mask=jnp.asarray(pad),
+                             attn_bias=bias)
+    np.testing.assert_allclose(
+        np.asarray(o_ref), np.asarray(o_flash), atol=5e-5
+    )

@@ -252,3 +252,38 @@ def test_bf16_compute_dtype(rng):
     # master params stay fp32
     for p in jax.tree_util.tree_leaves(trainer.state["params"]):
         assert p.dtype == jnp.float32
+
+
+def test_nonscaler_nan_aborts_with_detector(rng, caplog):
+    """bf16/fp32 runs must abort (not silently skip) on non-finite grads,
+    after naming the offending module (reference NanDetector semantics)."""
+    metrics.reset()
+    trainer = make_trainer()  # fp32, no scaler
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+    poisoned = jax.device_get(trainer.state["params"])
+    poisoned["embed"]["embedding"] = np.full_like(
+        poisoned["embed"]["embedding"], np.inf
+    )
+    from unicore_tpu.distributed import replicated
+
+    trainer.state["params"] = jax.device_put(
+        jax.tree_util.tree_map(jnp.asarray, poisoned), replicated(trainer.mesh)
+    )
+    with metrics.aggregate("train"):
+        with pytest.raises(FloatingPointError):
+            trainer.train_step([batch])
+
+
+def test_nan_detector_names_module(rng):
+    from unicore_tpu.nan_detector import find_nonfinite_modules
+
+    model = ToyModel()
+    batch = make_batch(rng)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(batch["net_input"]["src_tokens"])
+    )["params"]
+    params["out"]["kernel"] = jnp.full_like(params["out"]["kernel"], jnp.nan)
+    bad = find_nonfinite_modules(model, params, batch)
+    assert any("out" in name for name, _ in bad)

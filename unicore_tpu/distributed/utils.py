@@ -42,21 +42,23 @@ def distributed_init(args=None):
     coord = getattr(args, "distributed_init_method", None) if args else None
     if coord and coord.startswith("env://"):
         coord = None  # fall through to auto-detection
-    try:
-        if coord:
-            jax.distributed.initialize(
-                coordinator_address=coord.replace("tcp://", ""),
-                num_processes=getattr(args, "distributed_world_size", None),
-                process_id=getattr(args, "distributed_rank", None),
-            )
-        elif (
-            "SLURM_JOB_ID" in os.environ
-            or "COORDINATOR_ADDRESS" in os.environ
-            or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        ):
+    if coord:
+        # explicit coordinator: misconfiguration must fail fast, not fall
+        # back to a silent single-host run
+        jax.distributed.initialize(
+            coordinator_address=coord.replace("tcp://", ""),
+            num_processes=getattr(args, "distributed_world_size", None),
+            process_id=getattr(args, "distributed_rank", None),
+        )
+    elif (
+        "SLURM_JOB_ID" in os.environ
+        or "COORDINATOR_ADDRESS" in os.environ
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    ):
+        try:
             jax.distributed.initialize()
-    except Exception as e:  # already initialized or single-host
-        logger.debug("jax.distributed.initialize skipped: %s", e)
+        except Exception as e:  # already initialized
+            logger.warning("jax.distributed.initialize skipped: %s", e)
     return jax.process_index()
 
 
@@ -76,8 +78,22 @@ def get_mesh(args=None, devices=None):
     by args and consume devices from the data axis."""
     global _MESH
     jax = _jax()
-    if devices is None and _MESH is not None and args is None:
-        return _MESH
+    if devices is None and _MESH is not None:
+        # reuse the cached mesh (and its device subset) when it satisfies
+        # the requested axis sizes — callers like dryrun_multichip install
+        # a restricted-device mesh that later get_mesh(args) calls must not
+        # silently replace
+        tp_r = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1
+        sp_r = int(getattr(args, "seq_parallel_size", 1) or 1) if args else 1
+        fsdp_r = int(getattr(args, "fsdp_size", 1) or 1) if args else 1
+        shape = dict(zip(_MESH.axis_names, _MESH.devices.shape))
+        if (
+            shape.get("tensor", 1) == tp_r
+            and shape.get("seq", 1) == sp_r
+            and shape.get("fsdp", 1) == fsdp_r
+        ):
+            return _MESH
+        devices = list(_MESH.devices.flat)
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     tp = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1

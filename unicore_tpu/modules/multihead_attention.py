@@ -43,17 +43,43 @@ def _padding_bias(key_padding_mask, dtype):
     )
 
 
-def _attend(q, k, v, scaling, dropout, mask, bias, deterministic, make_rng,
-            return_attn=False):
-    """Core attention: q/k/v are [B, T, H, D]."""
+def _flash_ok(q, k, bias):
+    from unicore_tpu.ops.backend import use_pallas
+    from unicore_tpu.ops.pallas import flash_attention as fa
+
+    if not use_pallas():
+        return False
+    qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
+    ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
+    return fa.eligible(qs, ks, None if bias is None else bias.shape)
+
+
+def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
+            make_rng, return_attn=False):
+    """Core attention: q/k/v are [B, T, H, D].  Dispatches to the flash
+    (blockwise) Pallas kernel on TPU when eligible — the key padding mask
+    and (batch-broadcast) bias ride into the kernel separately, so the
+    [B, H, q, k] score matrix is never materialized.  The einsum +
+    fused-softmax path is the reference semantics and the fallback."""
     dtype = q.dtype
+    rng = None
+    if not deterministic and dropout > 0.0:
+        rng = make_rng("dropout")
+
+    if not return_attn and _flash_ok(q, k, bias):
+        from unicore_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, bias=bias, key_padding_mask=key_padding_mask,
+            dropout_prob=dropout, rng=rng, is_training=not deterministic,
+            scale=scaling,
+        )
+
+    mask = _padding_bias(key_padding_mask, dtype)
     # [B, H, q, k] scores; contraction + batched dims map directly to MXU.
     attn_weights = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k)
     if mask is not None:
         attn_weights = attn_weights + mask.astype(jnp.float32).astype(dtype)
-    rng = None
-    if not deterministic and dropout > 0.0:
-        rng = make_rng("dropout")
     if return_attn:
         attn_weights = attn_weights if bias is None else attn_weights + bias.astype(dtype)
         probs = ops.softmax_dropout(
@@ -100,11 +126,10 @@ class SelfMultiheadAttention(nn.Module):
         qkv = qkv.reshape(bsz, tgt_len, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        mask = _padding_bias(key_padding_mask, query.dtype)
         bias = _canon_bias(attn_bias, bsz, self.num_heads)
         out = _attend(
-            q, k, v, scaling, self.dropout, mask, bias, deterministic,
-            self.make_rng, return_attn=return_attn,
+            q, k, v, scaling, self.dropout, key_padding_mask, bias,
+            deterministic, self.make_rng, return_attn=return_attn,
         )
         if return_attn:
             o, attn_weights, probs = out
@@ -152,9 +177,9 @@ class CrossMultiheadAttention(nn.Module):
         k = proj(key, "k_proj")
         v = proj(value, "v_proj")
 
-        mask = _padding_bias(key_padding_mask, query.dtype)
         bias = _canon_bias(attn_bias, bsz, self.num_heads)
-        o = _attend(q, k, v, scaling, self.dropout, mask, bias, deterministic, self.make_rng)
+        o = _attend(q, k, v, scaling, self.dropout, key_padding_mask, bias,
+                    deterministic, self.make_rng)
         o = o.reshape(bsz, tgt_len, embed_dim)
         return nn.Dense(
             self.embed_dim, use_bias=self.bias, kernel_init=bert_init, name="out_proj"

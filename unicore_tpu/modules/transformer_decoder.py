@@ -150,13 +150,7 @@ class TransformerDecoder(nn.Module):
             fm = future_mask(seq_len)[None, None]
             attn_mask = fm if attn_mask is None else attn_mask + fm
 
-        if attn_mask is not None and padding_mask is not None:
-            attn_mask = jnp.where(
-                padding_mask.astype(bool)[:, None, None, :],
-                jnp.asarray(float("-inf"), dtype=jnp.float32),
-                attn_mask.astype(jnp.float32),
-            )
-            padding_mask = None
+        # padding mask intentionally NOT merged into attn_mask (see encoder)
 
         for i in range(self.decoder_layers):
             x = TransformerDecoderLayer(

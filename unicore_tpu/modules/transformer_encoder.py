@@ -187,15 +187,11 @@ class TransformerEncoder(nn.Module):
             )(seq_len)
             attn_mask = rel_pos_bias if attn_mask is None else attn_mask + rel_pos_bias
 
-        if attn_mask is not None and padding_mask is not None:
-            # merge key padding into the additive mask (reference
-            # transformer_encoder.py:147-155)
-            attn_mask = jnp.where(
-                padding_mask.astype(bool)[:, None, None, :],
-                jnp.asarray(float("-inf"), dtype=jnp.float32),
-                attn_mask.astype(jnp.float32),
-            )
-            padding_mask = None
+        # NOTE: unlike the reference (transformer_encoder.py:147-155), the
+        # key padding mask is NOT merged into the additive attention mask —
+        # the attention layer consumes them separately, which keeps the bias
+        # batch-broadcast so the flash kernel never materializes [B,H,T,T].
+        # Semantics are identical (-inf fill at padded keys either way).
 
         layer_cls = TransformerEncoderLayer
         if self.checkpoint_activations:

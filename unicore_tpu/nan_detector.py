@@ -1,0 +1,57 @@
+"""NaN/Inf localization.
+
+Parity target: ``unicore/nan_detector.py:15-109`` — the reference installs
+forward/backward module hooks and names the first module producing
+non-finite outputs when a FloatingPointError triggers a re-run.
+
+The flax-native equivalent: re-run the forward with
+``capture_intermediates=True`` and scan the intermediates tree host-side.
+No hooks, no mutation — one extra (uncompiled-cost-free, it jits like any
+forward) evaluation only on the failure path, exactly like the reference's
+re-run-under-detector flow (``trainer.py:733-754``)."""
+
+import logging
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def find_nonfinite_modules(model, params, sample, rngs=None, deterministic=True):
+    """Run a forward capturing all intermediates; return the module paths
+    (outermost-first) whose outputs contain non-finite values."""
+    _, state = model.apply(
+        {"params": params},
+        **sample["net_input"],
+        deterministic=deterministic,
+        rngs=rngs,
+        capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+    bad = []
+    flat = jax.tree_util.tree_flatten_with_path(state["intermediates"])[0]
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if not np.isfinite(arr).all():
+            name = "/".join(
+                getattr(p, "key", getattr(p, "idx", str(p)))
+                if not isinstance(p, jax.tree_util.SequenceKey)
+                else str(p.idx)
+                for p in path
+            )
+            n_bad = int((~np.isfinite(arr)).sum())
+            bad.append((name, n_bad))
+    return bad
+
+
+def log_nonfinite_modules(model, params, sample, rngs=None):
+    bad = find_nonfinite_modules(model, params, sample, rngs=rngs)
+    if not bad:
+        logger.warning(
+            "NanDetector: forward re-run produced no non-finite intermediates "
+            "(non-determinism or gradient-only NaN)"
+        )
+    for name, n in bad:
+        logger.warning("NanDetector: non-finite output in %s (%d values)", name, n)
+    return bad

@@ -1,0 +1,571 @@
+"""Flash (blockwise, online-softmax) attention Pallas kernel.
+
+The long-context replacement for materialized ``[B,H,Tq,Tk]`` attention —
+new capability relative to the reference, whose attention is plain
+``torch.bmm`` over full sequences (``unicore/modules/multihead_attention.py:83``,
+SURVEY §5.7).  Design:
+
+- additive bias (e.g. the T5 rel-pos bias, broadcastable over batch) and the
+  key-padding mask are SEPARATE inputs, so the combined ``[B,H,Tq,Tk]``
+  tensor is never built;
+- attention dropout rides inside the kernel via the counter-hash PRNG
+  (``prng.py``); the backward recomputes the identical mask;
+- backward is recompute-based (saves only out + logsumexp), split into a
+  dq pass and a dkv pass, with dbias accumulated across the sequential TPU
+  grid;
+- online softmax carries (m, l, acc) in VMEM scratch across the k-block
+  grid dimension (TPU grids execute sequentially).
+
+Layout: [B, H, T, D] inside the kernel; the public wrapper takes the
+module-standard [B, T, H, D].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from unicore_tpu.ops.backend import pallas_interpret
+from unicore_tpu.ops.pallas.prng import keep_mask
+
+NEG_INF = -1e30
+
+
+def _bias_spec(bias_shape, block_q, block_k):
+    """BlockSpec for a bias broadcastable to [B, H, Tq, Tk]."""
+    bB, bH, bQ, bK = bias_shape
+
+    def imap(b, h, i, j):
+        return (
+            0 if bB == 1 else b,
+            0 if bH == 1 else h,
+            0 if bQ == 1 else i,
+            j,
+        )
+
+    blk = (1, 1, 1 if bQ == 1 else block_q, block_k)
+    return pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM)
+
+
+def _pad_spec(block_k):
+    # key padding mask [B, 1, Tk] -> block [1, 1, block_k] (the middle
+    # singleton keeps Mosaic's sublane tiling rule satisfied)
+    return pl.BlockSpec(
+        (1, 1, block_k), lambda b, h, i, j: (b, 0, j), memory_space=pltpu.VMEM
+    )
+
+
+def _causal_mask(i, j, block_q, block_k, dtype):
+    rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols > rows, jnp.asarray(NEG_INF, dtype), 0.0)
+
+
+def _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if bias_ref is not None:
+        b = bias_ref[0, 0].astype(jnp.float32)  # [1 or Bq, Bk]
+        s = s + b
+    if pad_ref is not None:
+        pad = pad_ref[0, 0].astype(jnp.float32)  # [Bk]
+        s = s + jnp.where(pad > 0, NEG_INF, 0.0)[None, :]
+    if causal:
+        s = s + _causal_mask(i, j, block_q, block_k, jnp.float32)
+    return s
+
+
+def _mb_seed(seed_ref, b, h, i, j, n_h, n_i, n_j):
+    """Per-(batch, head, q-block, k-block) seed — identical across the
+    forward and all backward passes regardless of their grid layouts."""
+    return seed_ref[0] + ((b * n_h + h) * n_i + i) * n_j + j
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
+                scale, causal, dropout_prob, block_q, block_k, n_h, n_q, n_k):
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]  # [Bq, D]
+    k = k_ref[0, 0]  # [Bk, D]
+    v = v_ref[0, 0]  # [Bk, D]
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+
+    m_prev = m_scr[:, :1]  # [Bq, 1]
+    l_prev = l_scr[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)  # [Bq, Bk]
+    corr = jnp.exp(m_prev - m_new)  # [Bq, 1]
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        p_use = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
+    else:
+        p_use = p
+
+    pv = jax.lax.dot_general(
+        p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] = acc_scr[...] * corr + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_scr[...] / l_safe).astype(out_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l_safe)
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                *rest, has_bias, has_pad, scale, causal, dropout_prob,
+                block_q, block_k, n_h, n_q, n_k):
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    dk_ref, dv_ref, dk_scr, dv_scr = refs
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    j, i = pl.program_id(2), pl.program_id(3)  # grid: k blocks outer, q inner
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)  # [Bq, D]
+    lse = lse_ref[0, 0]  # [Bq, 1]
+    delta = delta_ref[0, 0]  # [Bq, 1] = rowsum(dO * O)
+
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)  # normalized probs [Bq, Bk]
+
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        p_drop = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
+    else:
+        keep = None
+        p_drop = p
+
+    # dv += p_drop^T @ dO
+    dv_scr[...] += jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dp~ = dO @ v^T ; dp = mask(dp~)/keep
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if keep is not None:
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_prob)), 0.0)
+    ds = p * (dp - delta)  # [Bq, Bk]
+    # dk += ds^T @ q * scale
+    dk_scr[...] += jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               *rest, has_bias, has_pad, scale, causal,
+               dropout_prob, block_q, block_k, n_h, n_q, n_k):
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    dq_ref, dq_scr = refs
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
+    ds = p * (dp - delta)
+    dq_scr[...] += jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  *rest, has_bias, has_pad, scale, causal, dropout_prob,
+                  block_q, block_k, n_h, n_q, n_k, n_b):
+    """dbias pass: grid (H, nQ, nK, B) — batch innermost, accumulated in
+    scratch (output blocks are written once, at b == B-1; accumulating into
+    output refs across grid steps is not portable)."""
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    dbias_ref, scr = refs
+
+    h, i = pl.program_id(0), pl.program_id(1)
+    j, b = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(b == 0)
+    def _():
+        scr[...] = jnp.zeros_like(scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
+    scr[...] += p * (dp - delta)
+
+    @pl.when(b == n_b - 1)
+    def _():
+        dbias_ref[0] = scr[...].astype(dbias_ref.dtype)
+
+
+def _pick_blocks(tq, tk):
+    bq = 256 if tq % 256 == 0 else (128 if tq % 128 == 0 else tq)
+    bk = 512 if tk % 512 == 0 else (128 if tk % 128 == 0 else tk)
+    return bq, bk
+
+
+def eligible(q_shape, k_shape, bias_shape):
+    """Whether the flash kernel supports these shapes ([B,H,T,D] layout)."""
+    _, _, tq, d = q_shape
+    tk = k_shape[2]
+    if tq % 128 != 0 or tk % 128 != 0:
+        return False
+    if d > 256 or d % 8 != 0:
+        return False
+    if bias_shape is not None:
+        if len(bias_shape) != 4:
+            return False
+        bB, bH, bQ, bK = bias_shape
+        # batch-broadcast bias only (the dbias pass accumulates over batch);
+        # batched biases fall back to the materialized path
+        if bB != 1 or bK != tk or bQ not in (1, tq):
+            return False
+    return True
+
+
+def _q_spec(block_q, d):
+    return pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _kv_spec(block_k, d):
+    return pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _lse_spec(block_q):
+    return pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _common(q, k, causal):
+    bsz, heads, tq, d = q.shape
+    tk = k.shape[2]
+    block_q, block_k = _pick_blocks(tq, tk)
+    grid = (bsz, heads, tq // block_q, tk // block_k)
+    return bsz, heads, tq, tk, d, block_q, block_k, grid
+
+
+def _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal)
+    in_specs = [_SEED_SPEC, _q_spec(block_q, d), _kv_spec(block_k, d),
+                _kv_spec(block_k, d)]
+    args = [seed, q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+        args.append(bias)
+    if pad is not None:
+        in_specs.append(_pad_spec(block_k))
+        args.append(pad)
+    kernel = functools.partial(
+        _fwd_kernel, has_bias=bias is not None, has_pad=pad is not None,
+        scale=scale, causal=causal, dropout_prob=dropout_prob,
+        block_q=block_q, block_k=block_k, n_h=heads, n_q=grid[2], n_k=grid[3],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[_q_spec(block_q, d), _lse_spec(block_q)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bsz, heads, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(*args)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 7, 8))
+def _flash(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
+    out, _ = _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
+    out, lse = _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale)
+    return out, (q, k, v, bias, pad, seed, out, lse)
+
+
+def _flash_bwd(dropout_prob, causal, scale, residuals, g):
+    q, k, v, bias, pad, seed, out, lse = residuals
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal)
+    n_q, n_k = grid[2], grid[3]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B,H,Tq,1]
+
+    common_in = [
+        _SEED_SPEC, _q_spec(block_q, d), _kv_spec(block_k, d),
+        _kv_spec(block_k, d), _q_spec(block_q, d), _lse_spec(block_q),
+        _lse_spec(block_q),
+    ]
+    common_args = [seed, q, k, v, g, lse, delta]
+    extra_in, extra_args = [], []
+    if bias is not None:
+        extra_in.append(_bias_spec(bias.shape, block_q, block_k))
+        extra_args.append(bias)
+    if pad is not None:
+        extra_in.append(_pad_spec(block_k))
+        extra_args.append(pad)
+
+    # ---- dq pass: grid (b, h, qi, kj), scratch accumulation over kj ----
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, has_bias=bias is not None, has_pad=pad is not None,
+            scale=scale, causal=causal, dropout_prob=dropout_prob,
+            block_q=block_q, block_k=block_k, n_h=heads, n_q=n_q, n_k=n_k,
+        ),
+        grid=grid,
+        in_specs=common_in + extra_in,
+        out_specs=_q_spec(block_q, d),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(*(common_args + extra_args))
+
+    # ---- dk/dv pass: grid (b, h, kj, qi), scratch accumulation over qi ----
+    dkv_grid = (bsz, heads, n_k, n_q)
+    q_spec_t = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec_t = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0),
+                             memory_space=pltpu.VMEM)
+    lse_spec_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0),
+                              memory_space=pltpu.VMEM)
+    dkv_in = [_SEED_SPEC, q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+              lse_spec_t, lse_spec_t]
+    if bias is not None:
+        bB, bH, bQ, bK = bias.shape
+        dkv_in.append(pl.BlockSpec(
+            (1, 1, 1 if bQ == 1 else block_q, block_k),
+            lambda b, h, j, i: (0 if bB == 1 else b, 0 if bH == 1 else h,
+                                0 if bQ == 1 else i, j),
+            memory_space=pltpu.VMEM,
+        ))
+    if pad is not None:
+        dkv_in.append(pl.BlockSpec(
+            (1, 1, block_k), lambda b, h, j, i: (b, 0, j),
+            memory_space=pltpu.VMEM,
+        ))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, has_bias=bias is not None, has_pad=pad is not None,
+            scale=scale, causal=causal, dropout_prob=dropout_prob,
+            block_q=block_q, block_k=block_k, n_h=heads, n_q=n_q, n_k=n_k,
+        ),
+        grid=dkv_grid,
+        in_specs=dkv_in,
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(*(common_args + extra_args))
+
+    # ---- dbias pass: grid (h, qi, kj, b), scratch accumulation over b ----
+    dbias = None
+    if bias is not None:
+        def hmap4(sel):
+            # index maps for the (h, i, j, b) grid
+            return {
+                "q": lambda h, i, j, b: (b, h, i, 0),
+                "kv": lambda h, i, j, b: (b, h, j, 0),
+                "lse": lambda h, i, j, b: (b, h, i, 0),
+                "pad": lambda h, i, j, b: (b, 0, j),
+            }[sel]
+
+        q_spec_b = pl.BlockSpec((1, 1, block_q, d), hmap4("q"),
+                                memory_space=pltpu.VMEM)
+        kv_spec_b = pl.BlockSpec((1, 1, block_k, d), hmap4("kv"),
+                                 memory_space=pltpu.VMEM)
+        lse_spec_b = pl.BlockSpec((1, 1, block_q, 1), hmap4("lse"),
+                                  memory_space=pltpu.VMEM)
+        db_in = [_SEED_SPEC, q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
+                 lse_spec_b, lse_spec_b]
+        db_args = [seed, q, k, v, g, lse, delta]
+        bB, bH, bQ, bK = bias.shape
+        db_in.append(pl.BlockSpec(
+            (1, 1, 1 if bQ == 1 else block_q, block_k),
+            lambda h, i, j, b: (0, 0 if bH == 1 else h, 0 if bQ == 1 else i, j),
+            memory_space=pltpu.VMEM,
+        ))
+        db_args.append(bias)
+        if pad is not None:
+            db_in.append(pl.BlockSpec((1, 1, block_k), hmap4("pad"),
+                                      memory_space=pltpu.VMEM))
+            db_args.append(pad)
+        dbias_full = pl.pallas_call(
+            functools.partial(
+                _dbias_kernel, has_bias=True, has_pad=pad is not None,
+                scale=scale, causal=causal, dropout_prob=dropout_prob,
+                block_q=block_q, block_k=block_k, n_h=heads, n_q=n_q,
+                n_k=n_k, n_b=bsz,
+            ),
+            grid=(heads, n_q, n_k, bsz),
+            in_specs=db_in,
+            out_specs=pl.BlockSpec(
+                (1, block_q, block_k), lambda h, i, j, b: (h, i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((heads, tq, tk), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+            interpret=pallas_interpret(),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary"),
+            ),
+        )(*db_args)
+        # reduce to the bias's broadcast shape ([1, bH, bQ, tk])
+        db = dbias_full[None]  # [1, H, Tq, Tk]
+        if bH == 1:
+            db = jnp.sum(db, axis=1, keepdims=True)
+        if bQ == 1:
+            db = jnp.sum(db, axis=2, keepdims=True)
+        dbias = db.astype(bias.dtype)
+
+    return dq, dk, dv, dbias, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v,
+    bias=None,
+    key_padding_mask=None,
+    causal=False,
+    dropout_prob=0.0,
+    rng=None,
+    is_training=True,
+    scale=None,
+):
+    """Blockwise attention.  q/k/v: [B, T, H, D] (module layout); ``bias``
+    broadcastable to [B, H, Tq, Tk]; ``key_padding_mask``: [B, Tk] with
+    nonzero = pad.  Returns [B, Tq, H, D]."""
+    bsz, tq, heads, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if bias is not None and bias.ndim < 4:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    p = float(dropout_prob) if is_training else 0.0
+    if p > 0.0:
+        if rng is None:
+            raise ValueError("flash_attention: rng required for dropout")
+        seed = jax.random.randint(rng, (1,), 0, 2 ** 31 - 1, dtype=jnp.int32)
+    else:
+        seed = jnp.zeros((1,), dtype=jnp.int32)
+    pad = None
+    if key_padding_mask is not None:
+        pad = key_padding_mask.astype(jnp.int32)[:, None, :]  # [B, 1, Tk]
+    out = _flash(qt, kt, vt, bias, pad, p, seed, causal, float(scale))
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,16 @@
+"""Sequence/context parallelism (new capability — SURVEY §5.7: the
+reference has NO long-context strategy; attention materializes the full
+``[B*H, Q, K]`` score matrix and sequence length is a hyperparameter bound).
+
+Two schemes over the mesh's ``seq`` axis:
+
+- ``ring_attention``: k/v blocks rotate around the ring (ppermute over ICI)
+  while each device owns its query block — memory per device is O(T/n),
+  communication overlaps with blockwise compute.
+- ``ulysses_attention``: all-to-all reshards seq <-> heads so each device
+  computes full-sequence attention for H/n heads (the reference's unused
+  ``all_to_all`` primitive, distributed/utils.py:281-288, realized).
+"""
+
+from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,123 @@
+"""Ring attention: blockwise attention with k/v rotating over a mesh axis.
+
+Called inside ``shard_map`` with q/k/v sharded along the sequence dim over
+``axis_name``.  Each of the n devices holds a [B, T/n, H, D] shard; k/v
+shards rotate n-1 times via ``jax.lax.ppermute`` (ICI neighbor exchange)
+while the online-softmax accumulator (m, l, acc) merges each incoming
+block — the distributed form of the flash kernel's inner loop, so per-device
+memory stays O(T/n · T/n) per block instead of O(T²).
+
+Ref: Liu et al., "Ring Attention with Blockwise Transformers" (2023),
+reimplemented from the paper's algorithm.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, bias_blk, q_offset, k_offset, causal):
+    """One q-shard x k-shard block: returns (m, l, pv) partials.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D].  All math fp32.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = s + jnp.where(cols > rows, NEG_INF, 0.0)[None, None]
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, pv
+
+
+def ring_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
+    """Distributed attention inside shard_map.
+
+    q/k/v: [B, T_local, H, D] (the local sequence shard).
+    bias: optional [1orB, H, T_local, T_global] — the bias columns for the
+    FULL key sequence (each device holds its query rows' bias).
+    Returns [B, T_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def bias_block(step):
+        if bias is None:
+            return None
+        src = (idx - step) % n  # which shard's k/v we hold at this step
+        return jax.lax.dynamic_slice_in_dim(bias, src * t_local, t_local, axis=3)
+
+    def body(carry, step):
+        k_cur, v_cur, m_acc, l_acc, o_acc = carry
+        src = (idx - step) % n
+        m_b, l_b, pv_b = _block_attend(
+            q, k_cur, v_cur, scale, bias_block(step),
+            idx * t_local, src * t_local, causal,
+        )
+        m_new = jnp.maximum(m_acc, m_b)
+        c_old = jnp.exp(m_acc - m_new)
+        c_new = jnp.exp(m_b - m_new)
+        l_new = l_acc * c_old + l_b * c_new
+        o_new = o_acc * c_old + pv_b * c_new
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    # pvary: scan carries must be marked device-varying under shard_map
+    m0 = jax.lax.pvary(jnp.full((b, h, t_local, 1), NEG_INF, dtype=jnp.float32), axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((b, h, t_local, 1), dtype=jnp.float32), axis_name)
+    o0 = jax.lax.pvary(jnp.zeros((b, h, t_local, d), dtype=jnp.float32), axis_name)
+    (k_f, v_f, m_f, l_f, o_f), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    del k_f, v_f
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+    out = (o_f / l_safe).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))  # [B, T_local, H, D]
+
+
+def ring_self_attention(mesh, q, k, v, bias=None, causal=False, scale=None,
+                        axis_name="seq"):
+    """Convenience wrapper: shard q/k/v over ``axis_name`` (sequence dim)
+    and run ring attention via shard_map.  q/k/v: [B, T, H, D] global."""
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(None, axis_name, None, None)
+    bias_spec = P(None, None, axis_name, None) if bias is not None else None
+    out_spec = P(None, axis_name, None, None)
+
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, scale=scale
+    )
+
+    if bias is not None:
+        wrapped = jax.shard_map(
+            lambda q_, k_, v_, b_: fn(q_, k_, v_, bias=b_),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
+            out_specs=out_spec,
+        )
+        return wrapped(q, k, v, bias)
+    wrapped = jax.shard_map(
+        lambda q_, k_, v_: fn(q_, k_, v_),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=out_spec,
+    )
+    return wrapped(q, k, v)

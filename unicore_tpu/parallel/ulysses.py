@@ -1,0 +1,66 @@
+"""Ulysses-style sequence parallelism: all-to-all seq <-> heads.
+
+Each device starts with a sequence shard [B, T/n, H, D]; an all-to-all over
+the ``seq`` axis reshards to [B, T, H/n, D] (full sequence, head shard), a
+plain full-sequence attention runs locally, and a second all-to-all reshards
+back.  This realizes the communication pattern of the reference's *unused*
+``all_to_all`` collective (distributed/utils.py:281-288) as an actual
+sequence-parallel scheme (Jacobs et al., DeepSpeed-Ulysses, 2023).
+
+Requires H % n == 0.  Attention math is exact (no blockwise approximation
+concerns) and any local attention impl can be used — including the flash
+kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _local_attention(q, k, v, bias, causal, scale):
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        t = q.shape[1]
+        m = jnp.triu(jnp.full((t, t), -1e30, dtype=jnp.float32), k=1)
+        s = s + m[None, None]
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
+    """Inside shard_map: q/k/v [B, T_local, H, D] sequence shards; returns
+    the same layout.  ``bias``: [1orB, H_local_after, T, T] is NOT resharded
+    (pass per-head-shard bias if needed)."""
+    n = jax.lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    assert h % n == 0, f"heads ({h}) must divide seq-parallel size ({n})"
+    if scale is None:
+        scale = d ** -0.5
+
+    def seq2head(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        x = x.reshape(b, t_local, n, h // n, d)
+        # all_to_all: split heads axis across devices, concat seq axis
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=True)
+        return x.reshape(b, t_local * n, h // n, d)
+
+    def head2seq(x):
+        # [B, T, H/n, D] -> [B, T/n, H, D]
+        t = x.shape[1]
+        x = x.reshape(b, n, t // n, h // n, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=True)
+        return x.reshape(b, t // n, h, d)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    if bias is not None:
+        # shard bias heads to this device's head block
+        hidx = jax.lax.axis_index(axis_name)
+        bias = jax.lax.dynamic_slice_in_dim(bias, hidx * (h // n), h // n, axis=1)
+    o = _local_attention(qh, kh, vh, bias, causal, scale)
+    return head2seq(o)

@@ -269,9 +269,12 @@ class Trainer:
         return jax.jit(train_step, donate_argnums=(0,))
 
     def _make_valid_step(self):
+        use_ema = bool(getattr(self.args, "validate_with_ema", False))
+
         def valid_step(state, batch, rng):
+            source = state["ema"] if (use_ema and "ema" in state) else state["params"]
             params = jax.tree_util.tree_map(
-                lambda p: p.astype(self.compute_dtype), state["params"]
+                lambda p: p.astype(self.compute_dtype), source
             )
             loss, sample_size, logging_output = self.task.loss_and_metrics(
                 self.model, self.loss, params, batch, rng, is_training=False
@@ -314,10 +317,25 @@ class Trainer:
         stats = jax.device_get(stats)
         overflow = bool(stats["overflow"] > 0)
         if overflow:
+            if not self.use_scaler:
+                # fp32/bf16 non-finite grads are a real failure: localize the
+                # first offending module, then abort (reference
+                # trainer.py:733-754 NanDetector re-run)
+                from unicore_tpu.nan_detector import log_nonfinite_modules
+
+                try:
+                    log_nonfinite_modules(
+                        self.model, self.state["params"],
+                        self._prepare_sample_host(samples[0]),
+                    )
+                except Exception as e:  # detector must never mask the abort
+                    logger.warning("NanDetector re-run failed: %s", e)
+                raise FloatingPointError(
+                    "Non-finite gradients detected (and no fp16 loss scaler "
+                    "to absorb them); see NanDetector log above."
+                )
             scale = float(stats["loss_scale"])
-            if self.use_scaler and scale <= float(
-                getattr(self.args, "min_loss_scale", 1e-4)
-            ):
+            if scale <= float(getattr(self.args, "min_loss_scale", 1e-4)):
                 raise FloatingPointError(
                     f"Minimum loss scale reached ({scale}). "
                     "Your loss is probably exploding."
@@ -395,33 +413,49 @@ class Trainer:
             else:
                 prepared.append(self._prepare_sample_host(s))
                 weights.append(1.0)
-        while len(prepared) < self.update_freq:
-            prepared.append(self._prepare_sample_host(self._dummy_batch))
-            weights.append(0.0)
         if self._dummy_batch is None:
             self._dummy_batch = prepared[0]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs, axis=0), *prepared
-        )
+
+        def stack(*xs):
+            shapes = {np.asarray(x).shape for x in xs}
+            if len(shapes) > 1:
+                raise ValueError(
+                    "micro-batches in one update group have mismatched "
+                    f"shapes {sorted(shapes)}; TPU training needs static "
+                    "shapes — pad batches to a fixed length (e.g. "
+                    "RightPadDataset(pad_to_length=...)) and a fixed batch "
+                    "size"
+                )
+            return np.stack(xs, axis=0)
+
+        stacked = jax.tree_util.tree_map(stack, *prepared)
         batches = self._to_device(stacked, stacked_micro=True)
         return batches, jnp.asarray(weights, dtype=jnp.float32)
 
     def _to_device(self, batch, stacked_micro=False):
         sharding = data_sharding(self.mesh)
         rep = replicated(self.mesh)
+        multihost = jax.process_count() > 1
 
         def put(x):
-            x = jnp.asarray(x)
+            x = np.asarray(x)
             dim = 1 if stacked_micro else 0
-            n_shards = int(np.prod(self.mesh.devices.shape[:2]))
-            if x.ndim > dim and x.shape[dim] % n_shards == 0:
+            n_local_shards = int(np.prod(self.mesh.devices.shape[:2]))
+            if multihost:
+                n_local_shards //= jax.process_count()
+            if x.ndim > dim and x.shape[dim] % max(n_local_shards, 1) == 0:
                 if stacked_micro:
                     spec = jax.sharding.PartitionSpec(None, ("data", "fsdp"))
                     s = jax.sharding.NamedSharding(self.mesh, spec)
                 else:
                     s = sharding
-                return jax.device_put(x, s)
-            return jax.device_put(x, rep)
+                if multihost:
+                    # each host holds its own shard of the global batch
+                    # (the iterator sharded by process rank); assemble the
+                    # global array from per-process data
+                    return jax.make_array_from_process_local_data(s, x)
+                return jax.device_put(jnp.asarray(x), s)
+            return jax.device_put(jnp.asarray(x), rep)
 
         return utils.tree_map_arrays(put, batch)
 
@@ -593,7 +627,10 @@ class Trainer:
             state = checkpoint_utils.load_checkpoint_to_cpu(filename)
             last_optim_state = state.get("optimizer_history", [{}])[-1]
             if state.get("model") is not None:
-                self._load_model_state(state["model"], reset_optimizer)
+                self._load_model_state(
+                    state["model"], reset_optimizer,
+                    optimizer_overrides=optimizer_overrides,
+                )
             if not reset_lr_scheduler and self.lr_scheduler is not None:
                 self.lr_scheduler.load_state_dict(
                     last_optim_state.get("lr_scheduler_state", {})
@@ -618,14 +655,39 @@ class Trainer:
             logger.info("No existing checkpoint found {}".format(filename))
         return extra_state
 
-    def _load_model_state(self, state_np, reset_optimizer):
+    def _load_model_state(self, state_np, reset_optimizer,
+                          optimizer_overrides=None):
+        if optimizer_overrides:
+            # reference --optimizer-overrides semantics
+            # (unicore_optimizer.py:87-90): override optimizer hyperparams
+            # at load time
+            for k, v in optimizer_overrides.items():
+                logger.info("overriding optimizer arg %s=%r", k, v)
+                setattr(self.args, k, v)
         self._build_optimizer()
         state = utils.tree_map_arrays(jnp.asarray, state_np)
-        if reset_optimizer and self.state is not None:
-            # keep freshly-initialized optimizer state, replace params only
-            self.state["params"] = jax.device_put(
-                state["params"], replicated(self.mesh)
-            )
+        if reset_optimizer:
+            # params only; optimizer state, scaler, EMA, step start fresh
+            params = state["params"]
+            fresh = {
+                "step": jnp.zeros((), dtype=jnp.int32),
+                "params": params,
+                "opt_state": self.optimizer.init(params),
+            }
+            if self.use_scaler:
+                fresh["scaler"] = scaler_init(
+                    float(getattr(self.args, "fp16_init_scale", 2 ** 7))
+                )
+            if self.ema_decay > 0:
+                fresh["ema"] = jax.tree_util.tree_map(jnp.copy, params)
+            self.state = jax.device_put(fresh, replicated(self.mesh))
         else:
+            if getattr(self.args, "load_from_ema", False) and "ema" in state:
+                # reference --load-from-ema (trainer.py:388-392): start from
+                # the EMA weights
+                logger.info("loading EMA weights as model params")
+                state["params"] = jax.tree_util.tree_map(
+                    jnp.copy, state["ema"]
+                )
             self.state = jax.device_put(state, replicated(self.mesh))
             self._num_updates = int(state_np["step"])
