@@ -1,7 +1,17 @@
 """Benchmark: BERT-base MLM training throughput (samples/sec/chip).
 
 Run by the driver on real TPU hardware at the end of every round.  Prints
-ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+This drives the framework's REAL hot path — ``Trainer.train_step`` (jitted
+SPMD step: bf16 compute, fp32 master params, grad-accum scan, clip,
+metrics) — not a hand-rolled step, so the number covers everything a user's
+training run pays for.
+
+Robustness: the dev TPU is reached through a relay that occasionally drops
+the compile stream (``remote_compile: read body closed``), so every config
+is retried with backoff and there is a ladder of smaller fallback configs.
+The JSON line is ALWAYS printed; degraded runs carry an ``"error"`` field.
 
 Baseline (BASELINE.md): the reference publishes no numbers; the
 driver-defined target is within 10% of an 8xA100 reference run on v5e-8.
@@ -14,22 +24,25 @@ import json
 import os
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 A100_REF_SAMPLES_PER_SEC = 185.0
 
-LAYERS, DIM, FFN, HEADS = 12, 768, 3072, 12
-VOCAB, SEQ = 30528, 512  # vocab padded to a 128 multiple
-BATCH = int(os.environ.get("BENCH_BATCH", "24"))
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-WARMUP = 3
+# BERT-base (reference examples/bert/model.py:225-237), vocab padded to a
+# 128-multiple.  Primary config first; ladder of smaller fallbacks after.
+CONFIGS = [
+    dict(batch=int(os.environ.get("BENCH_BATCH", "32")),
+         steps=int(os.environ.get("BENCH_STEPS", "20")), warmup=3, seq=512),
+    dict(batch=16, steps=10, warmup=2, seq=512),
+    dict(batch=8, steps=5, warmup=2, seq=256),
+]
+ATTEMPTS_PER_CONFIG = 3
+LAYERS, DIM, FFN, HEADS, VOCAB = 12, 768, 3072, 12, 30528
 
 
-def main():
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
+def _build_trainer(cfg):
     from argparse import Namespace
 
     sys.path.insert(
@@ -37,82 +50,121 @@ def main():
     )
     from model import BertModel
 
-    from unicore_tpu.optim import OPTIMIZER_REGISTRY
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.losses.masked_lm import MaskedLMLoss
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
 
+    args = Namespace(
+        seed=1, update_freq=[1], clip_norm=1.0, ema_decay=-1.0,
+        fp16=False, bf16=True, bf16_sr=False,
+        optimizer="adam", lr=[1e-4], adam_betas="(0.9, 0.98)",
+        adam_eps=1e-8, weight_decay=0.01,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=100000, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+
+    d = Dictionary()
+    # symbol count chosen so len(d) == VOCAB (4 specials pre-registered)
+    for i in range(VOCAB - 5):
+        d.add_symbol(f"tok{i}")
+    mask_idx = d.add_symbol("[MASK]", is_special=True)
+    assert len(d) == VOCAB, len(d)
+
+    class _Task(UnicoreTask):
+        def __init__(self, a):
+            super().__init__(a)
+            self.dictionary = d
+
+    task = _Task(args)
     model = BertModel(
-        vocab_size=VOCAB, padding_idx=0, encoder_layers=LAYERS,
+        vocab_size=VOCAB, padding_idx=d.pad(), encoder_layers=LAYERS,
         encoder_embed_dim=DIM, encoder_ffn_embed_dim=FFN,
-        encoder_attention_heads=HEADS, max_seq_len=SEQ,
+        encoder_attention_heads=HEADS, max_seq_len=cfg["seq"],
         emb_dropout=0.1, dropout=0.1, attention_dropout=0.1,
         activation_dropout=0.0, post_ln=True,
     )
+    loss = MaskedLMLoss(task)
+    return Trainer(args, task, model, loss), d, mask_idx
 
+
+def _make_batch(rng, d, mask_idx, batch, seq):
+    import numpy as np
+
+    toks = rng.randint(4, len(d) - 2, size=(batch, seq)).astype(np.int64)
+    tgt = np.full_like(toks, d.pad())
+    m = rng.rand(batch, seq) < 0.15
+    tgt[m] = toks[m]
+    toks[m] = mask_idx
+    return {"net_input": {"src_tokens": toks}, "target": tgt}
+
+
+def _run(cfg):
+    import numpy as np
+
+    from unicore_tpu import metrics
+    from unicore_tpu.distributed import utils as dist_utils
+
+    dist_utils.reset_mesh()
+    trainer, d, mask_idx = _build_trainer(cfg)
     rng = np.random.RandomState(0)
-    toks = rng.randint(4, VOCAB - 1, size=(BATCH, SEQ)).astype(np.int32)
-    target = np.full_like(toks, 0)
-    mask = rng.rand(BATCH, SEQ) < 0.15
-    target[mask] = toks[mask]
+    batch = _make_batch(rng, d, mask_idx, cfg["batch"], cfg["seq"])
 
-    key = jax.random.PRNGKey(0)
-    params = model.init(key, jnp.asarray(toks[:2]))["params"]
-    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+    metrics.reset()
+    with metrics.aggregate("train"):
+        for _ in range(cfg["warmup"]):
+            logs = trainer.train_step([batch])
+        # train_step device_gets its stats every step, so timing the host
+        # loop is an honest end-to-end measurement of the framework step
+        t0 = time.perf_counter()
+        for _ in range(cfg["steps"]):
+            logs = trainer.train_step([batch])
+        dt = time.perf_counter() - t0
 
-    opt = OPTIMIZER_REGISTRY["adam"](
-        Namespace(lr=[1e-4], adam_betas="(0.9, 0.98)", adam_eps=1e-8,
-                  weight_decay=0.01)
-    )
-    opt_state = opt.init(params)
+    final_loss = float(logs[0]["loss"])
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+    return cfg["batch"] * cfg["steps"] / dt, final_loss
 
-    def loss_fn(params_f32, toks, target, step_rng):
-        p_bf16 = jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.bfloat16), params_f32
-        )
-        logits = model.apply(
-            {"params": p_bf16}, toks, deterministic=False,
-            rngs={"dropout": step_rng},
-        )
-        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        m = (target != 0)
-        tgt = jnp.where(m, target, 0)
-        nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
 
-    @jax.jit
-    def train_step(params, opt_state, toks, target, step_rng):
-        loss, grads = jax.value_and_grad(loss_fn)(params, toks, target, step_rng)
-        updates, opt_state = opt.update(grads, opt_state, params, lr=1e-4)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        return params, opt_state, loss
-
-    toks_d = jnp.asarray(toks)
-    tgt_d = jnp.asarray(target)
-
-    for i in range(WARMUP):
-        params, opt_state, loss = train_step(
-            params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, i)
-        )
-    # device_get of the final chained loss forces the whole dependency chain
-    # to execute (block_until_ready alone does not synchronize through the
-    # axon relay on this dev setup)
-    float(jax.device_get(loss))
-
-    t0 = time.perf_counter()
-    for i in range(STEPS):
-        params, opt_state, loss = train_step(
-            params, opt_state, toks_d, tgt_d, jax.random.fold_in(key, WARMUP + i)
-        )
-    final_loss = float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-
-    samples_per_sec = BATCH * STEPS / dt
+def main():
+    errors = []
+    for ci, cfg in enumerate(CONFIGS):
+        for attempt in range(ATTEMPTS_PER_CONFIG):
+            try:
+                samples_per_sec, final_loss = _run(cfg)
+                out = {
+                    "metric": "bert_base_mlm_train_throughput",
+                    "value": round(samples_per_sec, 2),
+                    "unit": "samples/sec/chip",
+                    "vs_baseline": round(
+                        samples_per_sec / A100_REF_SAMPLES_PER_SEC, 3
+                    ),
+                    "config": {k: cfg[k] for k in ("batch", "seq", "steps")},
+                    "final_loss": round(final_loss, 4),
+                }
+                if ci > 0:
+                    out["error"] = (
+                        f"degraded: primary config failed, measured fallback "
+                        f"#{ci}; attempts: {errors[-3:]}"
+                    )
+                print(json.dumps(out))
+                return 0
+            except Exception as e:
+                tb = traceback.format_exc(limit=3)
+                errors.append(f"cfg{ci} attempt{attempt}: {e!r}")
+                sys.stderr.write(tb + "\n")
+                time.sleep(5 * (attempt + 1))
     print(json.dumps({
         "metric": "bert_base_mlm_train_throughput",
-        "value": round(samples_per_sec, 2),
+        "value": 0.0,
         "unit": "samples/sec/chip",
-        "vs_baseline": round(samples_per_sec / A100_REF_SAMPLES_PER_SEC, 3),
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors[-6:]),
     }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,8 +1,13 @@
-"""Symbol <-> index mapping (reference: unicore/data/dictionary.py:12-148).
+"""Vocabulary: symbol <-> integer id mapping.
 
-Same defaults as the reference: ``[CLS]/[PAD]/[SEP]/[UNK]`` specials, text
-file loading with ``#overwrite`` dedup control, and a vectorized
-``vec_index`` for whole-array token lookup.
+Behavioral parity target: ``unicore/data/dictionary.py:12-148`` (the four
+``[CLS]/[PAD]/[SEP]/[UNK]`` specials at ids 0-3, text-file persistence with
+an ``#overwrite`` escape hatch for duplicate rows, unk fallback on lookup,
+vectorized array lookup).  Independent implementation: ids are stored as a
+single ``{symbol: id}`` map plus parallel symbol/count columns, and
+``vec_index`` goes through a cached numpy sorted-key table instead of a
+per-element Python call, which is what tokenizing whole sequences actually
+needs on the hot data path.
 """
 
 import logging
@@ -11,156 +16,189 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+_DEFAULT_SPECIALS = ("[CLS]", "[PAD]", "[SEP]", "[UNK]")
+
 
 class Dictionary:
-    """A mapping from symbols to consecutive integers."""
+    """Maps symbols to consecutive integer ids, lowest id first."""
 
-    def __init__(
-        self,
-        *,
-        bos="[CLS]",
-        pad="[PAD]",
-        eos="[SEP]",
-        unk="[UNK]",
-        extra_special_symbols=None,
-    ):
-        self.bos_word, self.unk_word, self.pad_word, self.eos_word = bos, unk, pad, eos
-        self.symbols = []
-        self.count = []
-        self.indices = {}
+    def __init__(self, *, bos="[CLS]", pad="[PAD]", eos="[SEP]", unk="[UNK]",
+                 extra_special_symbols=None):
+        self.bos_word = bos
+        self.pad_word = pad
+        self.eos_word = eos
+        self.unk_word = unk
+        self._sym2id = {}
+        self._id2sym = []
+        self._counts = []
         self.specials = set()
-        self.bos_index = self.add_symbol(bos, is_special=True)
-        self.pad_index = self.add_symbol(pad, is_special=True)
-        self.eos_index = self.add_symbol(eos, is_special=True)
-        self.unk_index = self.add_symbol(unk, is_special=True)
-        if extra_special_symbols:
-            for s in extra_special_symbols:
-                self.add_symbol(s, is_special=True)
+        self._vec_cache = None
+        for word in (bos, pad, eos, unk):
+            self.add_symbol(word, is_special=True)
+        for word in extra_special_symbols or ():
+            self.add_symbol(word, is_special=True)
+        self.bos_index = self._sym2id[bos]
+        self.pad_index = self._sym2id[pad]
+        self.eos_index = self._sym2id[eos]
+        self.unk_index = self._sym2id[unk]
 
-    def __eq__(self, other):
-        return self.indices == other.indices
-
-    def __getitem__(self, idx):
-        if idx < len(self.symbols):
-            return self.symbols[idx]
-        return self.unk_word
-
-    def __len__(self):
-        """Returns the number of symbols in the dictionary."""
-        return len(self.symbols)
-
-    def __contains__(self, sym):
-        return sym in self.indices
-
-    def vec_index(self, a):
-        """Vectorized lookup of an array of symbols."""
-        return np.vectorize(self.index)(a)
-
-    def index(self, sym):
-        """Returns the index of the specified symbol."""
-        assert isinstance(sym, str)
-        if sym in self.indices:
-            return self.indices[sym]
-        if self.unk_word in self.indices:
-            return self.indices[self.unk_word]
-        raise KeyError(
-            f"symbol '{sym}' not in dictionary and no unk symbol is defined"
-        )
-
-    def special_index(self):
-        return [self.index(x) for x in self.specials]
+    # -- core mapping --------------------------------------------------
 
     def add_symbol(self, word, n=1, overwrite=False, is_special=False):
-        """Adds a word to the dictionary."""
+        """Register ``word`` (or bump its count); returns its id.
+
+        ``overwrite=True`` assigns a fresh id even if the symbol exists —
+        the contract behind the ``#overwrite`` file flag.
+        """
         if is_special:
             self.specials.add(word)
-        if word in self.indices and not overwrite:
-            idx = self.indices[word]
-            self.count[idx] = self.count[idx] + n
-            return idx
-        else:
-            idx = len(self.symbols)
-            self.indices[word] = idx
-            self.symbols.append(word)
-            self.count.append(n)
-            return idx
+        existing = self._sym2id.get(word)
+        if existing is not None and not overwrite:
+            self._counts[existing] += n
+            return existing
+        new_id = len(self._id2sym)
+        self._sym2id[word] = new_id
+        self._id2sym.append(word)
+        self._counts.append(n)
+        self._vec_cache = None
+        return new_id
+
+    def index(self, sym):
+        """Id of ``sym``; unknown symbols resolve to the unk id."""
+        assert isinstance(sym, str)
+        hit = self._sym2id.get(sym)
+        if hit is not None:
+            return hit
+        unk = self._sym2id.get(self.unk_word)
+        if unk is None:
+            raise KeyError(f"'{sym}' is out of vocabulary and no unk symbol exists")
+        return unk
+
+    def vec_index(self, a):
+        """Vectorized ``index`` over an array of symbol strings.
+
+        Uses a sorted-symbol ``np.searchsorted`` table (rebuilt only when
+        the vocab changes) — O(len(a) * log V) in numpy instead of one
+        Python dict probe per element.
+        """
+        if self._vec_cache is None:
+            order = np.argsort(np.asarray(self._id2sym))
+            self._vec_cache = (
+                np.asarray(self._id2sym)[order],  # sorted symbols
+                order.astype(np.int64),  # their ids
+            )
+        sorted_syms, ids = self._vec_cache
+        a = np.asarray(a)
+        pos = np.searchsorted(sorted_syms, a)
+        pos = np.clip(pos, 0, len(sorted_syms) - 1)
+        found = sorted_syms[pos] == a
+        return np.where(found, ids[pos], self.index(self.unk_word))
+
+    def special_index(self):
+        """Ids of every registered special symbol."""
+        return [self.index(s) for s in self.specials]
+
+    # -- container protocol --------------------------------------------
+
+    def __len__(self):
+        return len(self._id2sym)
+
+    def __contains__(self, sym):
+        return sym in self._sym2id
+
+    def __getitem__(self, idx):
+        return self._id2sym[idx] if idx < len(self._id2sym) else self.unk_word
+
+    def __eq__(self, other):
+        return isinstance(other, Dictionary) and self._sym2id == other._sym2id
+
+    # -- well-known ids ------------------------------------------------
 
     def bos(self):
-        """Helper to get index of beginning-of-sentence symbol"""
         return self.index(self.bos_word)
 
     def pad(self):
-        """Helper to get index of pad symbol"""
         return self.index(self.pad_word)
 
     def eos(self):
-        """Helper to get index of end-of-sentence symbol"""
         return self.index(self.eos_word)
 
     def unk(self):
-        """Helper to get index of unk symbol"""
         return self.index(self.unk_word)
+
+    # -- persistence ---------------------------------------------------
+    #
+    # File format, one symbol per line (the constructor's default specials
+    # are implicit and not written):
+    #
+    #     <symbol> <count>
+    #     <symbol> <count> #overwrite     <- claim a fresh id on collision
+    #
 
     @classmethod
     def load(cls, f):
-        """Loads the dictionary from a text file with the format:
-
-        ```
-        <symbol0> <count0>
-        <symbol1> <count1>
-        ...
-        ```
-        """
+        """Build a dictionary from a saved vocab file (path or handle)."""
         d = cls()
         d.add_from_file(f)
         return d
 
     def add_from_file(self, f):
-        """Loads a pre-existing dictionary from a text file and adds its
-        symbols to this instance."""
+        """Merge symbols from a vocab file into this dictionary."""
         if isinstance(f, str):
             try:
-                with open(f, "r", encoding="utf-8") as fd:
-                    self.add_from_file(fd)
-            except FileNotFoundError as fnfe:
-                raise fnfe
+                with open(f, "r", encoding="utf-8") as handle:
+                    self.add_from_file(handle)
             except UnicodeError:
-                raise Exception(f"Incorrect encoding detected in {f}, please rebuild the dataset")
+                raise Exception(
+                    f"vocab file {f} is not valid utf-8; rebuild the dataset"
+                )
             return
-
-        lines = f.readlines()
-
-        for line_idx, line in enumerate(lines):
+        rows = f.readlines()
+        for lineno, row in enumerate(rows):
+            row = row.rstrip()
+            overwrite = row.endswith(" #overwrite")
+            if overwrite:
+                row = row[: -len(" #overwrite")]
+            word, sep, count_field = row.rpartition(" ")
+            if not sep:
+                # bare-symbol row: synthesize a descending count so earlier
+                # rows rank higher, like the reference's positional default
+                word, count_field = row, str(len(rows) - lineno)
             try:
-                splits = line.rstrip().rsplit(" ", 1)
-                line = splits[0]
-                field = splits[1] if len(splits) > 1 else str(len(lines) - line_idx)
-                if field == "#overwrite":
-                    overwrite = True
-                    line, field = line.rsplit(" ", 1)
-                else:
-                    overwrite = False
-                count = int(field)
-                word = line
-                if word in self and not overwrite:
-                    logger.info(
-                        f"Duplicate word found when loading Dictionary: '{word}', "
-                        "skipping (add the #overwrite flag at the end of the row "
-                        "to replace the earlier entry)"
-                    )
-                else:
-                    self.add_symbol(word, n=count, overwrite=overwrite)
+                count = int(count_field)
             except ValueError:
                 raise ValueError(
-                    "Incorrect dictionary format, expected '<token> <cnt> [flags]'"
+                    f"bad vocab row {lineno + 1}: expected '<symbol> <count> "
+                    f"[#overwrite]', got {row!r}"
                 )
+            if word in self and not overwrite:
+                logger.info(
+                    "duplicate vocab symbol %r (line %d) skipped; append "
+                    "#overwrite to the row to force a new id", word, lineno + 1
+                )
+            else:
+                self.add_symbol(word, n=count, overwrite=overwrite)
 
     def save(self, f):
-        """Stores dictionary into a text file."""
+        """Write the vocab file (skipping the implicit default specials)."""
         if isinstance(f, str):
-            with open(f, "w", encoding="utf-8") as fd:
-                return self.save(fd)
-        defaults = {self.bos_word, self.pad_word, self.eos_word, self.unk_word}
-        for symbol, count in zip(self.symbols, self.count):
-            if symbol not in defaults:  # constructor re-creates the defaults
-                print(f"{symbol} {count}", file=f)
+            with open(f, "w", encoding="utf-8") as handle:
+                return self.save(handle)
+        implicit = {self.bos_word, self.pad_word, self.eos_word, self.unk_word}
+        for word, count in zip(self._id2sym, self._counts):
+            if word not in implicit:
+                f.write(f"{word} {count}\n")
+
+    # -- legacy attribute views (callers/tests that peek at internals) --
+
+    @property
+    def symbols(self):
+        return self._id2sym
+
+    @property
+    def count(self):
+        return self._counts
+
+    @property
+    def indices(self):
+        return self._sym2id

@@ -1,104 +1,122 @@
-"""Dict-of-datasets with per-leaf collation
-(reference: unicore/data/nested_dictionary_dataset.py).
+"""Composite dataset over a nested dict of leaf datasets.
 
-Flattens nested dicts to dotted keys ("net_input.src_tokens"), collates each
-leaf with its own dataset's collater, and unflattens the batch back to a
-nested dict.
+Behavioral parity target: ``unicore/data/nested_dictionary_dataset.py`` —
+a task declares its batch schema as a nested dict (possibly containing
+lists) of datasets, each leaf collates itself with its own ``collater``,
+and the collated batch comes back in the same nested shape
+(e.g. ``{"net_input": {"src_tokens": ...}, "target": ...}``).
+
+Independent implementation: the schema is walked once into a list of
+``(path, dataset)`` pairs, where ``path`` is a tuple of dict keys / list
+indices, and batches are assembled by direct path insertion — no dotted
+string keys, no unflatten parser.
 """
-
-from collections import OrderedDict
 
 import numpy as np
 
 from .unicore_dataset import UnicoreDataset
 
 
-def _flatten(dico, prefix=None):
-    """Flatten a nested dictionary."""
-    new_dico = OrderedDict()
-    if isinstance(dico, dict):
-        prefix = prefix + "." if prefix is not None else ""
-        for k, v in dico.items():
-            if v is None:
-                continue
-            new_dico.update(_flatten(v, prefix + k))
-    elif isinstance(dico, list):
-        for i, v in enumerate(dico):
-            new_dico.update(_flatten(v, prefix + f".[{i}]"))
+def _walk_leaves(node, path=()):
+    """Yield (path_tuple, leaf) for every non-dict/list leaf, depth-first."""
+    if isinstance(node, dict):
+        for k, v in node.items():
+            if v is not None:
+                yield from _walk_leaves(v, path + (k,))
+    elif isinstance(node, (list, tuple)):
+        for i, v in enumerate(node):
+            yield from _walk_leaves(v, path + (i,))
     else:
-        new_dico = OrderedDict({prefix: dico})
-    return new_dico
+        yield path, node
 
 
-def _unflatten(dico):
-    """Unflatten a flattened dictionary into a nested dictionary."""
-    new_dico = OrderedDict()
-    for full_k, v in dico.items():
-        full_k = full_k.split(".")
-        node = new_dico
-        for k in full_k[:-1]:
-            if k.startswith("[") and k.endswith("]"):
-                k = int(k[1:-1])
-            if k not in node:
-                node[k] = OrderedDict()
-            node = node[k]
-        node[full_k[-1]] = v
-    return new_dico
+def _insert(tree, path, value):
+    """Set ``tree[path[0]][path[1]]... = value``, growing dicts/lists."""
+    for depth, key in enumerate(path[:-1]):
+        nxt_is_list = isinstance(path[depth + 1], int)
+        if isinstance(key, int):
+            while len(tree) <= key:
+                tree.append([] if nxt_is_list else {})
+            tree = tree[key]
+        else:
+            if key not in tree:
+                tree[key] = [] if nxt_is_list else {}
+            tree = tree[key]
+    last = path[-1]
+    if isinstance(last, int):
+        while len(tree) <= last:
+            tree.append(None)
+        tree[last] = value
+    else:
+        tree[last] = value
 
 
 class NestedDictionaryDataset(UnicoreDataset):
+    """Zips equal-length leaf datasets into nested-dict samples."""
+
     def __init__(self, defn):
         super().__init__()
-        self.defn = _flatten(defn)
-
-        first = None
-        for v in self.defn.values():
-            if not isinstance(v, UnicoreDataset):
-                raise ValueError("Expected Dataset but found: {}".format(v.__class__))
-            first = first or v
-            if len(v) > 0:
-                assert len(v) == len(first), "dataset lengths must match"
-
-        self._len = len(first)
-
-    def __getitem__(self, index):
-        return OrderedDict((k, ds[index]) for k, ds in self.defn.items())
+        self.leaves = list(_walk_leaves(defn))
+        if not self.leaves:
+            raise ValueError("empty dataset definition")
+        lengths = set()
+        for path, ds in self.leaves:
+            if not isinstance(ds, UnicoreDataset):
+                raise ValueError(
+                    f"leaf {'.'.join(map(str, path))} is a "
+                    f"{type(ds).__name__}, expected a UnicoreDataset"
+                )
+            if len(ds) > 0:
+                lengths.add(len(ds))
+        if len(lengths) > 1:
+            raise ValueError(f"leaf dataset lengths differ: {sorted(lengths)}")
+        self._len = lengths.pop() if lengths else 0
 
     def __len__(self):
         return self._len
 
+    def __getitem__(self, index):
+        # samples stay in leaf-list form until collation; only the collated
+        # batch is materialized as a nested dict
+        return [ds[index] for _, ds in self.leaves]
+
     def collater(self, samples):
-        """Merge a list of samples to form a mini-batch."""
         if len(samples) == 0:
             return {}
-        sample = OrderedDict()
-        for k, ds in self.defn.items():
+        batch = {}
+        for slot, (path, ds) in enumerate(self.leaves):
+            column = [s[slot] for s in samples]
             try:
-                sample[k] = ds.collater([s[k] for s in samples])
+                merged = ds.collater(column)
             except NotImplementedError:
-                sample[k] = np.stack([np.asarray(s[k]) for s in samples])
-        return _unflatten(sample)
+                merged = np.stack([np.asarray(x) for x in column])
+            _insert(batch, path, merged)
+        return batch
+
+    # size accounting: a row is as big as its biggest leaf ---------------
 
     def num_tokens(self, index):
-        return max(ds.num_tokens(index) for ds in self.defn.values())
+        return max(ds.num_tokens(index) for _, ds in self.leaves)
 
     def size(self, index):
-        return max(ds.size(index) for ds in self.defn.values())
+        return max(ds.size(index) for _, ds in self.leaves)
 
-    @property
-    def supports_prefetch(self):
-        return any(ds.supports_prefetch for ds in self.defn.values())
-
-    def prefetch(self, indices):
-        for ds in self.defn.values():
-            if getattr(ds, "supports_prefetch", False):
-                ds.prefetch(indices)
-
-    @property
-    def can_reuse_epoch_itr_across_epochs(self):
-        return all(ds.can_reuse_epoch_itr_across_epochs for ds in self.defn.values())
+    # epoch / prefetch fan-out -------------------------------------------
 
     def set_epoch(self, epoch):
         super().set_epoch(epoch)
-        for ds in self.defn.values():
+        for _, ds in self.leaves:
             ds.set_epoch(epoch)
+
+    @property
+    def can_reuse_epoch_itr_across_epochs(self):
+        return all(ds.can_reuse_epoch_itr_across_epochs for _, ds in self.leaves)
+
+    @property
+    def supports_prefetch(self):
+        return any(getattr(ds, "supports_prefetch", False) for _, ds in self.leaves)
+
+    def prefetch(self, indices):
+        for _, ds in self.leaves:
+            if getattr(ds, "supports_prefetch", False):
+                ds.prefetch(indices)

@@ -110,6 +110,17 @@ def get_mesh(args=None, devices=None):
     return mesh
 
 
+def reset_mesh(mesh=None):
+    """Reset the cached global mesh (or install an explicit one).
+
+    The sanctioned way for harnesses (bench, dryrun, tests) to switch mesh
+    configuration between Trainer constructions — replaces ad-hoc pokes at
+    the module global."""
+    global _MESH
+    _MESH = mesh
+    return mesh
+
+
 def replicated(mesh):
     """Fully-replicated sharding (params, optimizer state under pure DP)."""
     jax = _jax()
