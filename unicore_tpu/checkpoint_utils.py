@@ -1,261 +1,61 @@
-"""Checkpoint save/load orchestration.
+"""Checkpoint lifecycle: naming, writing, retention, restore.
 
-Parity target: ``unicore/checkpoint_utils.py`` (315 LoC) — naming scheme
-(``checkpoint{epoch}.pt``, ``checkpoint_{epoch}_{upd}.pt``,
-``checkpoint_best.pt``, ``checkpoint.best_{metric}_{val}.pt``,
-``checkpoint_last.pt``), retention by ``--keep-interval-updates`` /
-``--keep-last-epochs`` / ``--keep-best-checkpoints``, tmp-dir write + async
-copy thread, atomic tmp+rename with retries, ``--finetune-from-model`` /
-``--reset-*`` semantics, and train-iterator state embedding.
+Behavioral parity target: ``unicore/checkpoint_utils.py`` — the
+``checkpoint{epoch}.pt`` / ``checkpoint_{epoch}_{upd}.pt`` /
+``checkpoint_best.pt`` / ``checkpoint.best_{metric}_{val}.pt`` /
+``checkpoint_last.pt`` naming family, retention via
+``--keep-interval-updates`` / ``--keep-last-epochs`` /
+``--keep-best-checkpoints``, fast-dir write + async copy to the final dir,
+atomic tmp+rename writes, and the ``--finetune-from-model`` / ``--reset-*``
+restore semantics with train-iterator fast-forward.
 
-Torch-free serialization: the state is a pytree of numpy arrays + python
-metadata, pickled (checkpoints stay ``.pt``-named for muscle-memory parity
-but are NOT torch format).  Every host reads the checkpoint itself on load
-— the reference's rank-0-read + ``broadcast_object`` of the whole state
-(trainer.py:356-382) is unnecessary under single-program SPMD.
+Independent implementation, organized around one :class:`CheckpointManager`
+that owns the best-metric tracker, the copy worker, and the save/restore
+decisions (the reference smears this state across function attributes and
+a thread pool threaded through every call).
+
+Serialization is a pickled pytree of numpy arrays + python metadata — NOT
+torch format.  Files keep the ``.pt`` suffix so reference launch scripts
+port over, but the loader peeks at the magic bytes and fails with a clear
+message when handed a real torch zipfile.
 """
 
 import ast
-import collections
 import logging
 import os
 import pickle
 import re
 import shutil
 import traceback
+from multiprocessing.pool import ThreadPool
 
 logger = logging.getLogger(__name__)
 
 
-def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
-    """Async copy tmp checkpoint to its final names + prune old ones
-    (reference checkpoint_utils.py:22-75)."""
-    has_copy = False
-    can_delete = args.tmp_save_dir != args.save_dir
-    for cp in checkpoints:
+# ----------------------------------------------------------------------
+# low-level IO
+# ----------------------------------------------------------------------
+
+def atomic_save(obj, filename, retries=3):
+    """Pickle ``obj`` to ``filename`` via tmp+rename; retried on IO errors.
+
+    Raises after the final retry — callers must not believe a failed write
+    succeeded (a stale scratch file copied under ``checkpoint_best.pt``
+    would silently desync from the tracked best metric)."""
+    for attempt in range(retries):
         try:
-            if src != cp:
-                logger.info("copy {} to {}".format(src, cp))
-                has_copy = True
-                shutil.copyfile(src, cp)
+            with open(filename + ".tmp", "wb") as f:
+                pickle.dump(obj, f, protocol=4)
+            os.replace(filename + ".tmp", filename)
+            return
         except Exception:
-            logger.info("copy failed, please copy it manually")
-    try:
-        if can_delete and has_copy and os.path.lexists(src):
-            logger.info("removing temp file {} ...".format(src))
-            os.remove(src)
-
-        def remove_ckps(root_path):
-            if not end_of_epoch and args.keep_interval_updates > 0:
-                ckps = checkpoint_paths(
-                    root_path, pattern=r"checkpoint_\d+_(\d+)\.pt"
-                )
-                for old_chk in ckps[args.keep_interval_updates:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info("removed {}".format(old_chk))
-            if args.keep_last_epochs > 0:
-                ckps = checkpoint_paths(root_path, pattern=r"checkpoint(\d+)\.pt")
-                for old_chk in ckps[args.keep_last_epochs:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info("removed {}".format(old_chk))
-            if args.keep_best_checkpoints > 0:
-                ckps = checkpoint_paths(
-                    root_path,
-                    pattern=r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
-                        args.best_checkpoint_metric
-                    ),
-                )
-                if not args.maximize_best_checkpoint_metric:
-                    ckps = ckps[::-1]
-                for old_chk in ckps[args.keep_best_checkpoints:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info("removed {}".format(old_chk))
-
-        remove_ckps(args.save_dir)
-    except Exception:
-        logger.info("remove old ckps error")
-    logger.info("finished async ckp saving.")
+            if attempt == retries - 1:
+                logger.error(traceback.format_exc())
+                raise
 
 
-def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
-                    do_save=True):
-    """Decide which checkpoint names to write this round and write them
-    (reference checkpoint_utils.py:77-151)."""
-    from unicore_tpu.logging import meters
-
-    if trainer.data_parallel_rank == 0:
-        os.makedirs(args.save_dir, exist_ok=True)
-        os.makedirs(args.tmp_save_dir, exist_ok=True)
-
-    prev_best = getattr(save_checkpoint, "best", val_loss)
-    if val_loss is not None:
-        best_function = max if args.maximize_best_checkpoint_metric else min
-        save_checkpoint.best = best_function(val_loss, prev_best)
-
-    if args.no_save or not do_save:
-        return
-    if not trainer.is_data_parallel_master:
-        return
-
-    write_timer = meters.StopwatchMeter()
-    write_timer.start()
-    epoch = epoch_itr.epoch
-    end_of_epoch = epoch_itr.end_of_epoch()
-    updates = trainer.get_num_updates()
-    logger.info(
-        f"Preparing to save checkpoint for epoch {epoch} @ {updates} updates"
-    )
-
-    def is_better(a, b):
-        return a >= b if args.maximize_best_checkpoint_metric else a <= b
-
-    suffix = getattr(args, "checkpoint_suffix", "") or ""
-    checkpoint_conds = collections.OrderedDict()
-    checkpoint_conds["checkpoint{}{}.pt".format(epoch, suffix)] = (
-        end_of_epoch
-        and not args.no_epoch_checkpoints
-        and epoch % args.save_interval == 0
-    )
-    checkpoint_conds["checkpoint_{}_{}{}.pt".format(epoch, updates, suffix)] = (
-        not end_of_epoch
-        and args.save_interval_updates > 0
-        and updates % args.save_interval_updates == 0
-    )
-    checkpoint_conds["checkpoint_best{}.pt".format(suffix)] = (
-        val_loss is not None
-        and (
-            not hasattr(save_checkpoint, "best")
-            or is_better(val_loss, save_checkpoint.best)
-        )
-    )
-    if val_loss is not None and args.keep_best_checkpoints > 0:
-        checkpoint_conds[
-            "checkpoint.best_{}_{:.2f}.pt".format(
-                args.best_checkpoint_metric, val_loss
-            )
-        ] = not hasattr(save_checkpoint, "best") or is_better(
-            val_loss, save_checkpoint.best
-        )
-    checkpoint_conds["checkpoint_last{}.pt".format(suffix)] = (
-        not args.no_last_checkpoints
-    )
-
-    extra_state = {
-        "train_iterator": epoch_itr.state_dict(),
-        "val_loss": val_loss,
-    }
-    if hasattr(save_checkpoint, "best"):
-        extra_state.update({"best": save_checkpoint.best})
-
-    checkpoints = [
-        os.path.join(args.save_dir, fn)
-        for fn, cond in checkpoint_conds.items()
-        if cond
-    ]
-    tmp_checkpoints = [
-        os.path.join(args.tmp_save_dir, fn)
-        for fn, cond in checkpoint_conds.items()
-        if cond
-    ]
-    if len(checkpoints) > 0:
-        trainer.save_checkpoint(tmp_checkpoints[0], extra_state)
-        if ckp_copy_thread is not None:
-            ckp_copy_thread.apply_async(
-                ckp_copy_fun, (tmp_checkpoints[0], checkpoints, end_of_epoch, args)
-            )
-        else:
-            ckp_copy_fun(tmp_checkpoints[0], checkpoints, end_of_epoch, args)
-        write_timer.stop()
-        logger.info(
-            "Saved checkpoint {} (epoch {} @ {} updates, score {}) "
-            "(writing took {} seconds)".format(
-                tmp_checkpoints[0], epoch, updates, val_loss, write_timer.sum
-            )
-        )
-
-
-def load_checkpoint(args, trainer, **passthrough_args):
-    """Load a checkpoint and restore the training iterator
-    (reference checkpoint_utils.py:153-243)."""
-    reset_optimizer = args.reset_optimizer
-    reset_lr_scheduler = args.reset_lr_scheduler
-    optimizer_overrides = ast.literal_eval(args.optimizer_overrides)
-    reset_meters = args.reset_meters
-    reset_dataloader = args.reset_dataloader
-
-    if args.finetune_from_model is not None and (
-        reset_optimizer or reset_lr_scheduler or reset_meters or reset_dataloader
-    ):
-        raise ValueError(
-            "--finetune-from-model can not be set together with either "
-            "--reset-optimizer or reset_lr_scheduler or reset_meters or "
-            "reset_dataloader"
-        )
-
-    suffix = getattr(args, "checkpoint_suffix", "") or ""
-    if args.restore_file == "checkpoint_last.pt":
-        checkpoint_path = os.path.join(
-            args.save_dir, "checkpoint_last{}.pt".format(suffix)
-        )
-        first_launch = not os.path.exists(checkpoint_path)
-        if args.finetune_from_model is not None and first_launch:
-            if os.path.exists(args.finetune_from_model):
-                checkpoint_path = args.finetune_from_model
-                reset_optimizer = True
-                reset_lr_scheduler = True
-                reset_meters = True
-                reset_dataloader = True
-                logger.info(
-                    f"loading pretrained model from {checkpoint_path}: "
-                    "optimizer, lr scheduler, meters, dataloader will be reset"
-                )
-            else:
-                raise ValueError(
-                    f"--finetune-from-model {args.finetune_from_model} does not exist"
-                )
-    elif suffix:
-        checkpoint_path = args.restore_file.replace(".pt", suffix + ".pt")
-    else:
-        checkpoint_path = args.restore_file
-
-    if args.restore_file != "checkpoint_last.pt" and args.finetune_from_model:
-        raise ValueError(
-            "--finetune-from-model and --restore-file (non-default value) "
-            "can not be specified together: " + str(args)
-        )
-
-    extra_state = trainer.load_checkpoint(
-        checkpoint_path,
-        reset_optimizer,
-        reset_lr_scheduler,
-        optimizer_overrides,
-        reset_meters=reset_meters,
-    )
-
-    if (
-        extra_state is not None
-        and "best" in extra_state
-        and not reset_optimizer
-        and not reset_meters
-    ):
-        save_checkpoint.best = extra_state["best"]
-
-    if extra_state is not None and not reset_dataloader:
-        itr_state = extra_state["train_iterator"]
-        epoch_itr = trainer.get_train_iterator(
-            epoch=itr_state["epoch"], load_dataset=True, **passthrough_args
-        )
-        epoch_itr.load_state_dict(itr_state)
-    else:
-        epoch_itr = trainer.get_train_iterator(
-            epoch=1, load_dataset=True, **passthrough_args
-        )
-    trainer.init_total_train_steps(epoch_itr)
-    trainer.lr_step(epoch_itr.epoch)
-    return extra_state, epoch_itr
+# API-parity alias (reference name; the payload was never torch here)
+torch_persistent_save = atomic_save
 
 
 def checkpoint_exists(path):
@@ -263,56 +63,284 @@ def checkpoint_exists(path):
 
 
 def load_checkpoint_to_cpu(path, arg_overrides=None):
-    """Load a checkpoint into host memory (reference checkpoint_utils.py:245)."""
+    """Read a checkpoint into host memory (numpy pytree + metadata)."""
     with open(path, "rb") as f:
+        magic = f.read(2)
+        f.seek(0)
+        if magic == b"PK":
+            raise ValueError(
+                f"{path} is a torch-format (zip) checkpoint; this framework "
+                "writes pickled numpy pytrees. Convert reference Uni-Core "
+                "weights first: python -m unicore_tpu.tools.convert_torch_checkpoint "
+                f"{path} <out.pt>"
+            )
         state = pickle.load(f)
-    if "args" in state and state["args"] is not None and arg_overrides is not None:
-        args = state["args"]
-        for arg_name, arg_val in arg_overrides.items():
-            setattr(args, arg_name, arg_val)
+    if arg_overrides and state.get("args") is not None:
+        for name, value in arg_overrides.items():
+            setattr(state["args"], name, value)
     return state
 
 
-def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
-    """All checkpoints in ``path`` matching ``pattern``, sorted by the first
-    group descending (reference checkpoint_utils.py:259)."""
-    pt_regexp = re.compile(pattern)
-    files = os.listdir(path)
-    entries = []
-    for i, f in enumerate(files):
-        m = pt_regexp.fullmatch(f)
-        if m is not None:
-            idx = float(m.group(1)) if len(m.groups()) > 0 else i
-            entries.append((idx, m.group(0)))
-    return [os.path.join(path, x[1]) for x in sorted(entries, reverse=True)]
-
-
-def torch_persistent_save(obj, filename):
-    """Atomic pickle write: tmp + rename, 3 retries
-    (reference checkpoint_utils.py:282-299; name kept for API parity —
-    the payload is a pickled numpy pytree, not torch)."""
-    for i in range(3):
-        try:
-            with open(filename + ".tmp", "wb") as f:
-                pickle.dump(obj, f, protocol=4)
-            os.rename(filename + ".tmp", filename)
-            return
-        except Exception:
-            if i == 2:
-                logger.error(traceback.format_exc())
-
-
 def verify_checkpoint_directory(save_dir: str) -> None:
-    if not os.path.exists(save_dir):
-        os.makedirs(save_dir, exist_ok=True)
-    temp_file_path = os.path.join(save_dir, "dummy")
+    """Fail fast if the checkpoint directory is not writable."""
+    os.makedirs(save_dir, exist_ok=True)
+    probe = os.path.join(save_dir, ".write-probe")
     try:
-        with open(temp_file_path, "w"):
+        with open(probe, "w"):
             pass
-    except OSError as e:
-        logger.warning(
-            "Unable to access checkpoint save directory: {}".format(save_dir)
+    except OSError:
+        logger.warning("checkpoint directory is not writable: %s", save_dir)
+        raise
+    os.remove(probe)
+
+
+def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
+    """Checkpoints under ``path`` matching ``pattern``, newest-first by the
+    numeric capture group."""
+    rx = re.compile(pattern)
+    scored = []
+    for name in os.listdir(path):
+        m = rx.fullmatch(name)
+        if m:
+            score = float(m.group(1)) if m.groups() else 0.0
+            scored.append((score, name))
+    return [os.path.join(path, name) for _, name in sorted(scored, reverse=True)]
+
+
+# ----------------------------------------------------------------------
+# retention
+# ----------------------------------------------------------------------
+
+def _prune(args, end_of_epoch):
+    """Delete checkpoints beyond the configured retention windows."""
+    keep = []
+    if not end_of_epoch and args.keep_interval_updates > 0:
+        keep.append((r"checkpoint_\d+_(\d+)\.pt", args.keep_interval_updates,
+                     False))
+    if args.keep_last_epochs > 0:
+        keep.append((r"checkpoint(\d+)\.pt", args.keep_last_epochs, False))
+    if args.keep_best_checkpoints > 0:
+        keep.append((
+            r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
+                args.best_checkpoint_metric),
+            args.keep_best_checkpoints,
+            not args.maximize_best_checkpoint_metric,
+        ))
+    for pattern, limit, reverse in keep:
+        survivors = checkpoint_paths(args.save_dir, pattern=pattern)
+        if reverse:
+            survivors = survivors[::-1]
+        for stale in survivors[limit:]:
+            if os.path.lexists(stale):
+                os.remove(stale)
+                logger.info("removed old checkpoint %s", stale)
+
+
+# ----------------------------------------------------------------------
+# manager
+# ----------------------------------------------------------------------
+
+class BestTracker:
+    """Running best of the checkpoint metric (min or max)."""
+
+    def __init__(self, maximize):
+        self.maximize = maximize
+        self.value = None
+
+    def is_better(self, a, b):
+        return a >= b if self.maximize else a <= b
+
+    def update(self, val):
+        """Fold ``val`` in; returns True if it is (tied-)best so far."""
+        if val is None:
+            return False
+        if self.value is None or self.is_better(val, self.value):
+            self.value = val
+            return True
+        return False
+
+
+class CheckpointManager:
+    """Owns checkpoint writing, retention, best tracking, and restore."""
+
+    def __init__(self, args, is_master):
+        self.args = args
+        self.is_master = is_master
+        self.best = BestTracker(args.maximize_best_checkpoint_metric)
+        self._worker = None
+        if is_master and not args.no_save:
+            verify_checkpoint_directory(args.save_dir)
+            verify_checkpoint_directory(args.tmp_save_dir)
+            # one background worker copies tmp-dir writes to the (possibly
+            # slow, shared) save dir and prunes — reference
+            # unicore_cli/train.py:60 + checkpoint_utils.py:22-75
+            self._worker = ThreadPool(processes=1)
+
+    # -- save ----------------------------------------------------------
+
+    def _target_names(self, epoch, updates, end_of_epoch, val_loss,
+                      improved):
+        """Which checkpoint filenames this round's state should land in."""
+        a, suffix = self.args, getattr(self.args, "checkpoint_suffix", "") or ""
+        names = []
+        if (end_of_epoch and not a.no_epoch_checkpoints
+                and epoch % a.save_interval == 0):
+            names.append(f"checkpoint{epoch}{suffix}.pt")
+        if (not end_of_epoch and a.save_interval_updates > 0
+                and updates % a.save_interval_updates == 0):
+            names.append(f"checkpoint_{epoch}_{updates}{suffix}.pt")
+        if val_loss is not None and improved:
+            names.append(f"checkpoint_best{suffix}.pt")
+            if a.keep_best_checkpoints > 0:
+                names.append(
+                    f"checkpoint.best_{a.best_checkpoint_metric}_"
+                    f"{val_loss:.2f}.pt"
+                )
+        if not a.no_last_checkpoints:
+            names.append(f"checkpoint_last{suffix}.pt")
+        return names
+
+    def save(self, trainer, epoch_itr, val_loss, do_save=True):
+        """Write this round's checkpoint under every applicable name."""
+        improved = self.best.update(val_loss)
+        if self.args.no_save or not do_save or not trainer.is_data_parallel_master:
+            return
+        epoch = epoch_itr.epoch
+        end_of_epoch = epoch_itr.end_of_epoch()
+        updates = trainer.get_num_updates()
+        names = self._target_names(epoch, updates, end_of_epoch, val_loss,
+                                   improved)
+        if not names:
+            return
+
+        extra_state = {
+            "train_iterator": epoch_itr.state_dict(),
+            "val_loss": val_loss,
+        }
+        if self.best.value is not None:
+            extra_state["best"] = self.best.value
+
+        import time
+        t0 = time.perf_counter()
+        scratch = os.path.join(self.args.tmp_save_dir, names[0])
+        finals = [os.path.join(self.args.save_dir, n) for n in names]
+        try:
+            trainer.save_checkpoint(scratch, extra_state)
+        except Exception:
+            logger.error(
+                "checkpoint write to %s FAILED; skipping copy/retention for "
+                "this round", scratch, exc_info=True,
+            )
+            return
+        job = (scratch, finals, end_of_epoch)
+        if self._worker is not None:
+            self._worker.apply_async(self._finalize, job)
+        else:
+            self._finalize(*job)
+        logger.info(
+            "Saved checkpoint %s (epoch %d @ %d updates, score %s) "
+            "(writing took %.1f seconds)",
+            scratch, epoch, updates, val_loss, time.perf_counter() - t0,
         )
-        raise e
-    else:
-        os.remove(temp_file_path)
+
+    def _finalize(self, scratch, finals, end_of_epoch):
+        """Copy the scratch write to its final names, then prune."""
+        copied_any = False
+        for dst in finals:
+            if dst == scratch:
+                continue
+            try:
+                shutil.copyfile(scratch, dst)
+                copied_any = True
+                logger.info("copied %s -> %s", scratch, dst)
+            except Exception:
+                logger.warning("checkpoint copy to %s failed; copy manually",
+                               dst)
+        try:
+            if (copied_any and self.args.tmp_save_dir != self.args.save_dir
+                    and os.path.lexists(scratch)):
+                os.remove(scratch)
+            _prune(self.args, end_of_epoch)
+        except Exception:
+            logger.warning("checkpoint retention pass failed", exc_info=True)
+
+    def close(self):
+        if self._worker is not None:
+            self._worker.close()
+            self._worker.join()
+            self._worker = None
+
+    # -- restore -------------------------------------------------------
+
+    def _resolve_restore(self):
+        """Pick the restore path and which state groups to reset.
+
+        Returns (path, reset flags dict).  Reference semantics
+        (checkpoint_utils.py:161-209): ``--finetune-from-model`` only
+        applies on first launch with the default ``--restore-file`` and
+        forces a full reset of optimizer/scheduler/meters/dataloader.
+        """
+        a = self.args
+        suffix = getattr(a, "checkpoint_suffix", "") or ""
+        resets = {
+            "optimizer": a.reset_optimizer,
+            "lr_scheduler": a.reset_lr_scheduler,
+            "meters": a.reset_meters,
+            "dataloader": a.reset_dataloader,
+        }
+        if a.finetune_from_model is not None and any(resets.values()):
+            raise ValueError(
+                "--finetune-from-model cannot be combined with --reset-* "
+                "flags (it implies all of them on first launch)"
+            )
+        if a.restore_file != "checkpoint_last.pt":
+            if a.finetune_from_model:
+                raise ValueError(
+                    "--finetune-from-model and a non-default --restore-file "
+                    "cannot be used together"
+                )
+            if suffix:
+                return a.restore_file.replace(".pt", suffix + ".pt"), resets
+            return a.restore_file, resets
+
+        path = os.path.join(a.save_dir, f"checkpoint_last{suffix}.pt")
+        if a.finetune_from_model is not None and not os.path.exists(path):
+            if not os.path.exists(a.finetune_from_model):
+                raise ValueError(
+                    f"--finetune-from-model {a.finetune_from_model} does not "
+                    "exist"
+                )
+            logger.info(
+                "first launch: finetuning from %s (optimizer, lr scheduler, "
+                "meters, dataloader start fresh)", a.finetune_from_model,
+            )
+            return a.finetune_from_model, {k: True for k in resets}
+        return path, resets
+
+    def restore(self, trainer, **itr_kwargs):
+        """Load the restore checkpoint (if any) and build the train iterator."""
+        path, resets = self._resolve_restore()
+        extra_state = trainer.load_checkpoint(
+            path,
+            resets["optimizer"],
+            resets["lr_scheduler"],
+            ast.literal_eval(self.args.optimizer_overrides),
+            reset_meters=resets["meters"],
+        )
+        if (extra_state is not None and "best" in extra_state
+                and not resets["optimizer"] and not resets["meters"]):
+            self.best.value = extra_state["best"]
+
+        if extra_state is not None and not resets["dataloader"]:
+            itr_state = extra_state["train_iterator"]
+            epoch_itr = trainer.get_train_iterator(
+                epoch=itr_state["epoch"], load_dataset=True, **itr_kwargs
+            )
+            epoch_itr.load_state_dict(itr_state)
+        else:
+            epoch_itr = trainer.get_train_iterator(
+                epoch=1, load_dataset=True, **itr_kwargs
+            )
+        trainer.init_total_train_steps(epoch_itr)
+        trainer.lr_step(epoch_itr.epoch)
+        return extra_state, epoch_itr

@@ -1,34 +1,28 @@
-"""Batch iterator stack (reference: unicore/data/iterators.py).
+"""Epoch/batch iteration for the training loop.
 
-Torch-free re-implementation of the full iterator contract:
+Behavioral parity target: the iterator contract of
+``unicore/data/iterators.py`` — multi-epoch iteration over a frozen batch
+list with deterministic per-epoch shuffling, round-robin data-parallel
+sharding padded so every worker sees the same number of steps (empty
+batches become the trainer's zero-weight dummies), parallel batch
+materialization, background prefetch, and mid-epoch checkpoint resume with
+proportional offset rescaling when the world size changes between runs.
 
-- :class:`CountingIterator` — resume bookkeeping.
-- :class:`EpochBatchIterator` — checkpointable multi-epoch iterator over a
-  frozen batch list: per-epoch shuffle under ``numpy_seed(seed + epoch)``,
-  round-robin sharding across data-parallel workers (padding short shards
-  with empty batches so all workers stay in lockstep), worker-pool batch
-  materialization, background prefetch, and ``state_dict`` /
-  ``load_state_dict`` with proportional offset rescaling when the
-  world size changes between runs.
-- :class:`GroupedIterator` — chunks of ``update_freq`` batches for gradient
-  accumulation.
-- :class:`ShardedIterator` — round-robin slice of an iterable.
-- :class:`BufferedIterator` — daemon-thread prefetch (the host->device
-  overlap path; the trainer additionally overlaps ``jax.device_put``).
-
-The torch ``DataLoader`` worker-subprocess pool is replaced by a thread pool
-(numpy-heavy collation releases the GIL; subprocess isolation buys nothing
-for mmap-backed record stores).
+Independent implementation: the reference stacks four wrappers
+(DataLoader -> Buffered -> Sharded -> Counting) around a stateful epoch
+object; here one :class:`_EpochStream` owns a shard's batch plan, cursor,
+worker pool, and prefetch thread, and :class:`EpochBatchIterator` is a
+thin orchestrator that plans epochs and (de)serializes position.  Batches
+are materialized by a thread pool rather than worker subprocesses: the
+collation path is numpy (GIL-releasing) over mmap-backed record stores,
+where processes buy isolation nothing needs and lose zero-copy reads.
 """
 
 import itertools
 import logging
 import math
-import operator
-import os
 import queue
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -37,518 +31,381 @@ from . import data_utils
 
 logger = logging.getLogger(__name__)
 
-# Object used by _background_consumer to signal the source is exhausted
-_sentinel = object()
-
 
 class CountingIterator:
-    """Wrapper around an iterable that maintains the iteration count.
+    """Iterator wrapper tracking an absolute position ``n``.
 
-    Args:
-        iterable (iterable): iterable to wrap
-        start (int): starting iteration count
-        total (int): override the iterator length
-
-    Attributes:
-        n (int): number of elements consumed from this iterator
+    ``total`` is the absolute end position; ``skip``/``take`` adjust the
+    window.  Building block for resumable iteration.
     """
 
     def __init__(self, iterable, start=None, total=None):
-        self.iterable = iterable
-        self.itr = iter(self)
-
-        if start is None:
-            self.n = getattr(iterable, "n", 0)
-        else:
-            self.n = start
-
-        if total is None:
-            self.total = self.n + len(iterable)
-        else:
-            self.total = total
+        self._source = iter(iterable)
+        self.n = start if start is not None else getattr(iterable, "n", 0)
+        self.total = total if total is not None else self.n + len(iterable)
 
     def __len__(self):
         return self.total
 
     def __iter__(self):
-        for x in self.iterable:
-            if self.n >= self.total:
-                return  # truncated via take()
-            self.n += 1
-            yield x
+        return self
 
     def __next__(self):
-        return next(self.itr)
+        if self.n >= self.total:
+            raise StopIteration
+        try:
+            value = next(self._source)
+        except StopIteration:
+            self.total = self.n
+            raise
+        self.n += 1
+        return value
 
     def has_next(self):
-        """Whether the iterator has been exhausted."""
-        return self.n < len(self)
+        return self.n < self.total
 
-    def skip(self, num_to_skip):
-        """Fast-forward the iterator by skipping *num_to_skip* elements."""
-        next(itertools.islice(self.itr, num_to_skip, num_to_skip), None)
+    def skip(self, count):
+        """Advance past ``count`` elements."""
+        for _ in itertools.repeat(None, count):
+            try:
+                next(self)
+            except StopIteration:
+                break
         return self
 
     def take(self, n):
-        """Truncates the iterator to n elements at most."""
+        """Cap the absolute end position at ``n``."""
         self.total = min(self.total, n)
-        # Propagate this change to the underlying iterator
-        if hasattr(self.iterable, "take"):
-            self.iterable.take(n)
-        else:
-            self.iterable = itertools.islice(self.iterable, n)
+        return self
 
 
-class EpochBatchIterating:
-    def __len__(self) -> int:
-        raise NotImplementedError
+class GroupedIterator(CountingIterator):
+    """Yields lists of up to ``chunk_size`` items — the grad-accumulation
+    micro-batch groups consumed by ``Trainer.train_step``."""
 
-    @property
-    def next_epoch_idx(self):
-        raise NotImplementedError
+    def __init__(self, iterable, chunk_size):
+        def chunks():
+            source = iter(iterable)
+            while True:
+                group = list(itertools.islice(source, chunk_size))
+                if not group:
+                    return
+                yield group
 
-    def next_epoch_itr(self, shuffle=True, fix_batches_to_gpus=False):
-        raise NotImplementedError
-
-    def end_of_epoch(self) -> bool:
-        raise NotImplementedError
-
-    @property
-    def iterations_in_epoch(self) -> int:
-        raise NotImplementedError
-
-    def state_dict(self):
-        raise NotImplementedError
-
-    def load_state_dict(self, state_dict):
-        raise NotImplementedError
+        super().__init__(
+            chunks(),
+            start=-(-getattr(iterable, "n", 0) // chunk_size),
+            total=-(-len(iterable) // chunk_size),
+        )
+        self.chunk_size = chunk_size
 
 
-class EpochBatchIterator(EpochBatchIterating):
-    """A multi-epoch iterator over a fixed dataset + frozen batch sampler.
+class ShardedIterator(CountingIterator):
+    """Round-robin shard view of an iterable, padded with ``fill_value`` so
+    every shard has equal length (the data-parallel lockstep guarantee)."""
 
-    Compared with a plain iterator this supports:
-    - reusing across epochs without rebuilding batches,
-    - per-epoch shuffling of whole batches (seeded, deterministic),
-    - sharding across data-parallel workers with lockstep padding,
-    - serialization for mid-epoch checkpoint resume.
+    def __init__(self, iterable, num_shards, shard_id, fill_value=None):
+        if not 0 <= shard_id < num_shards:
+            raise ValueError(
+                f"shard_id must be in [0, {num_shards}), got {shard_id}"
+            )
+        shard_len = -(-len(iterable) // num_shards)
+
+        def sharded():
+            mine = itertools.islice(
+                iter(iterable), shard_id, None, num_shards
+            )
+            produced = 0
+            for item in mine:
+                produced += 1
+                yield item
+            for _ in range(shard_len - produced):
+                yield fill_value
+
+        super().__init__(
+            sharded(),
+            start=getattr(iterable, "n", 0) // num_shards,
+            total=shard_len,
+        )
+
+
+class BufferedIterator(CountingIterator):
+    """Bounded background prefetch of an iterator on a daemon thread.
+
+    Thin position-tracking shell over :func:`_prefetch_thread` (one shared
+    prefetch implementation); ``take`` truncation propagates to the inner
+    iterator so the producer stops early too."""
+
+    def __init__(self, size, iterable):
+        self._inner = iterable
+        super().__init__(
+            _prefetch_thread(iter(iterable), size),
+            start=getattr(iterable, "n", 0),
+            total=len(iterable),
+        )
+
+    def take(self, n):
+        super().take(n)
+        if hasattr(self._inner, "take"):
+            self._inner.take(n)
+        return self
+
+
+class _EpochStream:
+    """One shard's batches for one epoch: plan + cursor + materialization.
+
+    ``plan`` is the full per-shard list of index lists (``[]`` entries are
+    lockstep padding and materialize as ``{}`` dummy batches); ``n`` is the
+    absolute position within the plan, so a stream built at a resume
+    offset reports positions consistent with a fresh one.
     """
 
-    def __init__(
-        self,
-        dataset,
-        collate_fn,
-        batch_sampler,
-        seed=1,
-        num_shards=1,
-        shard_id=0,
-        num_workers=0,
-        epoch=1,
-        buffer_size=0,
-        timeout=0,
-        disable_shuffling=False,
-    ):
+    def __init__(self, dataset, collate_fn, plan, offset=0, num_workers=0,
+                 buffer_size=0):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.plan = plan
+        self.n = offset
+        self.total = len(plan)
+        self.num_workers = num_workers
+        self.buffer_size = buffer_size
+        self._iter = None
+
+    def __len__(self):
+        return self.total
+
+    def has_next(self):
+        return self.n < self.total
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._iter is None:
+            self._iter = self._produce()
+        return next(self._iter)
+
+    def _load(self, indices):
+        if len(indices) == 0:
+            return {}  # lockstep dummy; trainer assigns it zero weight
+        return self.collate_fn([self.dataset[int(i)] for i in indices])
+
+    def _produce(self):
+        todo = self.plan[self.n:]
+        if self.num_workers > 0:
+            source = self._pooled(todo)
+        else:
+            source = map(self._load, todo)
+        if self.buffer_size > 0:
+            source = _prefetch_thread(source, self.buffer_size)
+        for batch in source:
+            self.n += 1
+            yield batch
+
+    def _pooled(self, todo):
+        """Materialize with a thread pool, at most ~2x workers in flight so
+        loading can't run an entire epoch ahead of the consumer."""
+        window = 2 * self.num_workers
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        try:
+            backlog = iter(todo)
+            inflight = [
+                pool.submit(self._load, b)
+                for b in itertools.islice(backlog, window)
+            ]
+            inflight.reverse()  # pop() from the tail = FIFO order
+            while inflight:
+                done = inflight.pop()
+                nxt = next(backlog, None)
+                if nxt is not None:
+                    inflight.insert(0, pool.submit(self._load, nxt))
+                yield done.result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _prefetch_thread(source, depth):
+    """Generator view of ``source`` pumped by a daemon thread."""
+    q = queue.Queue(maxsize=depth)
+    DONE = object()
+
+    def pump():
+        try:
+            for item in source:
+                q.put(item)
+        except Exception as e:
+            q.put(e)
+            return
+        q.put(DONE)
+
+    threading.Thread(target=pump, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is DONE:
+            return
+        if isinstance(item, Exception):
+            raise item
+        yield item
+
+
+class EpochBatchIterator:
+    """Checkpointable multi-epoch iterator over a frozen batch list.
+
+    Each epoch: (re)shuffle the global batch list under
+    ``numpy_seed(seed + epoch)``, slice out this worker's round-robin
+    shard (padded to lockstep length), and stream it through a
+    :class:`_EpochStream`.  ``state_dict``/``load_state_dict`` carry the
+    epoch and the in-epoch position, rescaling the position
+    proportionally when the per-shard epoch length changed (e.g. a resume
+    at a different world size).
+    """
+
+    def __init__(self, dataset, collate_fn, batch_sampler, seed=1,
+                 num_shards=1, shard_id=0, num_workers=0, epoch=1,
+                 buffer_size=0, timeout=0, disable_shuffling=False):
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.batch_sampler = batch_sampler
-        self._frozen_batches = (
-            tuple(batch_sampler) if not callable(batch_sampler) else None
+        self._global_batches = (
+            None if callable(batch_sampler) else tuple(batch_sampler)
         )
         self.seed = seed
         self.num_shards = num_shards
         self.shard_id = shard_id
         self.num_workers = num_workers
-        # This upper limit here is to prevent people from abusing this feature
-        # in a shared computing environment.
-        self.buffer_size = min(buffer_size, 20)
-        self.timeout = timeout
+        self.buffer_size = min(buffer_size, 32)
         self.disable_shuffling = disable_shuffling
 
-        self.epoch = max(epoch, 1)  # we use 1-based indexing for epochs
+        self.epoch = max(epoch, 1)
         self.shuffle = not disable_shuffling
-        self._cur_epoch_itr = None
-        self._next_epoch_itr = None
-        self._supports_prefetch = getattr(dataset, "supports_prefetch", False)
+        self._active = None  # current epoch's stream
+        self._resumed = None  # stream prebuilt by load_state_dict
+
+    # -- batch planning ------------------------------------------------
 
     @property
     def frozen_batches(self):
-        if self._frozen_batches is None:
-            self._frozen_batches = tuple(self.batch_sampler(self.dataset, self.epoch))
-        return self._frozen_batches
+        if self._global_batches is None:
+            self._global_batches = tuple(
+                self.batch_sampler(self.dataset, self.epoch)
+            )
+        return self._global_batches
 
     @property
     def first_batch(self):
+        """A materialized prototype batch (shape/dtype reference)."""
         if len(self.frozen_batches) == 0:
             raise Exception(
-                "The dataset is empty. This could indicate "
-                "that all elements in the dataset have been skipped. "
-                "Try increasing the max number of allowed tokens or using "
-                "a larger dataset."
+                "empty dataset (every sample may have been filtered out)"
             )
-        if getattr(self.dataset, "supports_fetch_outside_dataloader", True):
-            return self.collate_fn([self.dataset[i] for i in self.frozen_batches[0]])
-        else:
-            return "DUMMY"
+        return self.collate_fn(
+            [self.dataset[int(i)] for i in self.frozen_batches[0]]
+        )
+
+    def _shard_plan(self, epoch, shuffle):
+        """This worker's padded batch list for ``epoch``."""
+        batches = list(self.frozen_batches)
+        if shuffle:
+            with data_utils.numpy_seed(self.seed + epoch):
+                order = np.random.permutation(len(batches))
+            batches = [batches[i] for i in order]
+        mine = batches[self.shard_id::self.num_shards]
+        mine += [[]] * (len(self) - len(mine))  # lockstep padding
+        return mine
+
+    def _open_stream(self, epoch, shuffle, offset=0):
+        plan = self._shard_plan(epoch, shuffle)
+        if offset > 0 and offset >= len(plan):
+            return None
+        if getattr(self.dataset, "supports_prefetch", False):
+            self.dataset.prefetch([i for b in plan for i in b])
+        return _EpochStream(
+            self.dataset, self.collate_fn, plan, offset=offset,
+            num_workers=self.num_workers, buffer_size=self.buffer_size,
+        )
+
+    # -- epoch protocol ------------------------------------------------
 
     def __len__(self):
-        return int(math.ceil(len(self.frozen_batches) / float(self.num_shards)))
+        return -(-len(self.frozen_batches) // self.num_shards)
 
     @property
     def n(self):
         return self.iterations_in_epoch
 
     @property
+    def iterations_in_epoch(self):
+        if self._active is not None:
+            return self._active.n
+        if self._resumed is not None:
+            return self._resumed.n
+        return 0
+
+    @property
     def next_epoch_idx(self):
-        """Return the epoch index after *next_epoch_itr* is called."""
-        if self._next_epoch_itr is not None:
+        if self._resumed is not None:
             return self.epoch
-        elif self._cur_epoch_itr is not None and self.end_of_epoch():
+        if self._active is not None and self.end_of_epoch():
             return self.epoch + 1
-        else:
-            return self.epoch
+        return self.epoch
 
-    def next_epoch_itr(self, shuffle=True, fix_batches_to_gpus=False):
-        """Return a new iterator over the dataset.
-
-        Args:
-            shuffle (bool, optional): shuffle batches before returning the
-                iterator (default: True).
-            fix_batches_to_gpus (bool, optional): ensure that batches are
-                always allocated to the same shards across epochs. Requires
-                that :attr:`dataset` supports prefetching (default: False).
-        """
+    def next_epoch_itr(self, shuffle=True):
         if self.disable_shuffling:
             shuffle = False
         self.epoch = self.next_epoch_idx
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(self.epoch)
-        if self._next_epoch_itr is not None:
-            self._cur_epoch_itr = self._next_epoch_itr
-            self._next_epoch_itr = None
+        if self._resumed is not None:
+            self._active, self._resumed = self._resumed, None
         else:
             if callable(self.batch_sampler):
-                # reset _frozen_batches to refresh the next epoch
-                self._frozen_batches = None
-            self._cur_epoch_itr = self._get_iterator_for_epoch(
-                self.epoch,
-                shuffle,
-                fix_batches_to_gpus=fix_batches_to_gpus,
-            )
+                self._global_batches = None  # refresh for the new epoch
+            self._active = self._open_stream(self.epoch, shuffle)
         self.shuffle = shuffle
-        return self._cur_epoch_itr
+        return self._active
 
     def end_of_epoch(self) -> bool:
-        """Returns whether the most recent epoch iterator has been exhausted"""
-        return not self._cur_epoch_itr.has_next()
+        return self._active is not None and not self._active.has_next()
 
-    @property
-    def iterations_in_epoch(self):
-        """The number of consumed batches in the current epoch."""
-        if self._cur_epoch_itr is not None:
-            return self._cur_epoch_itr.n
-        elif self._next_epoch_itr is not None:
-            return self._next_epoch_itr.n
-        return 0
+    # -- checkpoint state ----------------------------------------------
 
     def state_dict(self):
-        """Returns a dictionary containing a whole state of the iterator."""
         if self.end_of_epoch():
-            epoch = self.epoch + 1
-            iter_in_epoch = 0
+            epoch, position = self.epoch + 1, 0
         else:
-            epoch = self.epoch
-            iter_in_epoch = self.iterations_in_epoch
+            epoch, position = self.epoch, self.iterations_in_epoch
         return {
             "version": 2,
             "epoch": epoch,
-            "iterations_in_epoch": iter_in_epoch,
+            "iterations_in_epoch": position,
             "shuffle": self.shuffle,
             "len": len(self),
         }
 
     def load_state_dict(self, state_dict):
-        """Copies the state of the iterator from the given *state_dict*."""
         self.epoch = state_dict["epoch"]
-        itr_pos = state_dict.get("iterations_in_epoch", 0)
-        version = state_dict.get("version", 1)
-        # proportional offset rescale when the per-shard epoch length changed
-        # (world size / update-freq change between runs; reference
-        # iterators.py:326-350)
-        old_len = state_dict.get("len", None)
-        if old_len is not None and old_len != len(self) and itr_pos > 0:
-            itr_pos = int(round(itr_pos * len(self) / float(old_len)))
+        position = state_dict.get("iterations_in_epoch", 0)
+        saved_len = state_dict.get("len")
+        if saved_len not in (None, len(self)) and position > 0:
+            # per-shard epoch length changed (world size / batching changed
+            # between runs): keep the same fraction of the epoch consumed
+            rescaled = int(round(position * len(self) / float(saved_len)))
             logger.info(
-                f"iterator length changed ({old_len} -> {len(self)}); "
-                f"rescaled resume offset to {itr_pos}"
+                "epoch length changed (%d -> %d); resume position %d -> %d",
+                saved_len, len(self), position, rescaled,
             )
-        if itr_pos > 0:
-            # fast-forward epoch iterator
-            self._next_epoch_itr = self._get_iterator_for_epoch(
-                self.epoch,
-                shuffle=state_dict.get("shuffle", True),
-                offset=itr_pos,
+            position = rescaled
+        if position > 0:
+            self._resumed = self._open_stream(
+                self.epoch, state_dict.get("shuffle", True), offset=position
             )
-            if self._next_epoch_itr is None:
-                if version == 1:
-                    # legacy behavior: we finished the epoch, increment epoch counter
-                    self.epoch += 1
+            if self._resumed is None:
+                if state_dict.get("version", 1) == 1:
+                    self.epoch += 1  # legacy: epoch finished exactly at save
                 else:
                     raise RuntimeError(
-                        "Cannot resume training due to dataloader mismatch. You can relaunch "
-                        "training with `--reset-dataloader` and it should work."
+                        "cannot resume: saved position is past the end of "
+                        "the epoch; relaunch with --reset-dataloader"
                     )
         else:
-            self._next_epoch_itr = None
-
-    def _get_iterator_for_epoch(
-        self, epoch, shuffle, fix_batches_to_gpus=False, offset=0
-    ):
-        def shuffle_batches(batches, seed):
-            with data_utils.numpy_seed(seed):
-                np.random.shuffle(batches)
-            return batches
-
-        if self._supports_prefetch:
-            batches = self.frozen_batches
-            if shuffle and not fix_batches_to_gpus:
-                batches = shuffle_batches(list(batches), self.seed + epoch)
-            batches = list(
-                ShardedIterator(batches, self.num_shards, self.shard_id, fill_value=[])
-            )
-            self.dataset.prefetch([i for s in batches for i in s])
-            if shuffle and fix_batches_to_gpus:
-                batches = shuffle_batches(batches, self.seed + epoch + self.shard_id)
-        else:
-            if shuffle:
-                batches = shuffle_batches(list(self.frozen_batches), self.seed + epoch)
-            else:
-                batches = self.frozen_batches
-            batches = list(
-                ShardedIterator(batches, self.num_shards, self.shard_id, fill_value=[])
-            )
-
-        if offset > 0 and offset >= len(batches):
-            return None
-
-        # The world-size-rescaled offset path (reference iterators.py:326-350)
-        # lives in load_state_dict via proportional len scaling; here we just
-        # skip `offset` batches.
-        itr = _BatchLoaderIterator(
-            self.dataset,
-            self.collate_fn,
-            batches[offset:],
-            num_workers=self.num_workers,
-            timeout=self.timeout,
-        )
-
-        if self.buffer_size > 0:
-            itr = BufferedIterator(self.buffer_size, itr)
-
-        # Wrap with CountingIterator
-        itr = CountingIterator(itr, start=offset, total=len(batches))
-        return itr
-
-
-class _BatchLoaderIterator:
-    """Materializes batches: dataset[i] for each index, then collate.
-
-    With ``num_workers > 0`` a thread pool maps upcoming batches ahead of the
-    consumer (the torch-DataLoader-worker equivalent for a GIL-releasing
-    numpy pipeline).
-    """
-
-    def __init__(self, dataset, collate_fn, batches, num_workers=0, timeout=0):
-        self.dataset = dataset
-        self.collate_fn = collate_fn
-        self.batches = batches
-        self.num_workers = num_workers
-        self.timeout = timeout
-
-    def __len__(self):
-        return len(self.batches)
-
-    def _make_batch(self, batch_indices):
-        # empty index lists come from lockstep shard padding: produce the
-        # sentinel the trainer turns into a zero-weight dummy batch
-        if len(batch_indices) == 0:
-            return {}
-        return self.collate_fn([self.dataset[int(i)] for i in batch_indices])
-
-    def __iter__(self):
-        if self.num_workers > 0:
-            # bounded-window submission: at most ~2*workers batches in
-            # flight, so workers can't run the whole epoch ahead of the
-            # consumer (torch DataLoader-equivalent backpressure)
-            from collections import deque
-
-            window = 2 * self.num_workers
-            pool = ThreadPoolExecutor(max_workers=self.num_workers)
-            try:
-                pending = deque()
-                batch_iter = iter(self.batches)
-                for b in itertools.islice(batch_iter, window):
-                    pending.append(pool.submit(self._make_batch, b))
-                while pending:
-                    batch = pending.popleft().result()
-                    nxt = next(batch_iter, None)
-                    if nxt is not None:
-                        pending.append(pool.submit(self._make_batch, nxt))
-                    yield batch
-            finally:
-                pool.shutdown(wait=False, cancel_futures=True)
-        else:
-            for batch_indices in self.batches:
-                yield self._make_batch(batch_indices)
-
-
-class GroupedIterator(CountingIterator):
-    """Wrapper around an iterable that returns groups (chunks) of items
-    (grad-accumulation micro-batch groups, reference iterators.py:406-435).
-
-    Args:
-        iterable (iterable): iterable to wrap
-        chunk_size (int): size of each chunk
-    """
-
-    def __init__(self, iterable, chunk_size):
-        itr = _chunk_iterator(iterable, chunk_size)
-        super().__init__(
-            itr,
-            start=int(math.ceil(getattr(iterable, "n", 0) / float(chunk_size))),
-            total=int(math.ceil(len(iterable) / float(chunk_size))),
-        )
-        self.chunk_size = chunk_size
-
-
-def _chunk_iterator(itr, chunk_size):
-    chunk = []
-    for x in itr:
-        chunk.append(x)
-        if len(chunk) == chunk_size:
-            yield chunk
-            chunk = []
-    if len(chunk) > 0:
-        yield chunk
-
-
-class ShardedIterator(CountingIterator):
-    """A sharded wrapper around an iterable, padded to length
-    (reference iterators.py:438-468).
-
-    Args:
-        iterable (iterable): iterable to wrap
-        num_shards (int): number of shards to split the iterable into
-        shard_id (int): which shard to iterator over
-        fill_value (Any, optional): padding value when the iterable doesn't
-            evenly divide *num_shards* (default: None). The trainer relies on
-            ``fill_value=[]`` producing dummy batches that keep collectives
-            in lockstep.
-    """
-
-    def __init__(self, iterable, num_shards, shard_id, fill_value=None):
-        if shard_id < 0 or shard_id >= num_shards:
-            raise ValueError("shard_id must be between 0 and num_shards")
-        sharded_len = int(math.ceil(len(iterable) / float(num_shards)))
-        itr = map(
-            operator.itemgetter(1),
-            itertools.zip_longest(
-                range(sharded_len),
-                itertools.islice(iterable, shard_id, len(iterable), num_shards),
-                fillvalue=fill_value,
-            ),
-        )
-        super().__init__(
-            itr,
-            start=int(math.ceil(getattr(iterable, "n", 0) / float(num_shards))),
-            total=sharded_len,
-        )
-
-
-class BackgroundConsumer(threading.Thread):
-    def __init__(self, queue, source, max_len):
-        threading.Thread.__init__(self)
-
-        self._queue = queue
-        self._source = source
-        self._max_len = max_len
-        self.count = 0
-
-    def run(self):
-        try:
-            for item in self._source:
-                self._queue.put(item)
-
-                # Stop if we reached the real end of the iterator
-                self.count += 1
-                if self.count >= self._max_len:
-                    break
-
-            # Signal the consumer we are done.
-            self._queue.put(_sentinel)
-        except Exception as e:
-            self._queue.put(e)
-
-
-class BufferedIterator:
-    """Daemon-thread prefetch of an inner iterator (host-side pipelining;
-    reference iterators.py:496-554)."""
-
-    def __init__(self, size, iterable):
-        self._queue = queue.Queue(size)
-        self._iterable = iterable
-        self._consumer = None
-
-        self.start_time = time.time()
-        self.warning_time = None
-
-        self.total = len(iterable)
-
-    def _create_consumer(self):
-        self._consumer = BackgroundConsumer(
-            self._queue,
-            self._iterable,
-            self.total,
-        )
-        self._consumer.daemon = True
-        self._consumer.start()
-
-    def __iter__(self):
-        return self
-
-    def __len__(self):
-        return self.total
-
-    def take(self, n):
-        self.total = min(self.total, n)
-        # Propagate this change to the underlying iterator
-        if hasattr(self._iterable, "take"):
-            self._iterable.take(n)
-        else:
-            self._iterable = itertools.islice(self._iterable, n)
-
-    def __next__(self):
-        # Create consumer if not created yet
-        if self._consumer is None:
-            self._create_consumer()
-
-        # Notify the user if there is a data loading bottleneck
-        if self._queue.qsize() < min(2, max(1, self._queue.maxsize // 2)):
-            if time.time() - self.start_time > 5 * 60:
-                if (
-                    self.warning_time is None
-                    or time.time() - self.warning_time > 15 * 60
-                ):
-                    logger.debug(
-                        "Data loading buffer is empty or nearly empty. This may "
-                        "indicate a data loading bottleneck, and increasing the "
-                        "number of workers (--num-workers) may help."
-                    )
-                    self.warning_time = time.time()
-
-        # Get next example
-        item = self._queue.get(True)
-        if isinstance(item, Exception):
-            raise item
-        if item is _sentinel:
-            raise StopIteration()
-        return item
+            self._resumed = None

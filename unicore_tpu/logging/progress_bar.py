@@ -1,16 +1,20 @@
-"""Progress-bar renderers over batch iterators.
+"""Progress reporting over batch iterators.
 
-Same renderer taxonomy as the reference (``unicore/logging/progress_bar.py``):
-``json`` / ``simple`` / ``tqdm`` / ``none`` formats plus an optional
-tensorboard wrapper with one SummaryWriter per tag. The renderers are
-host-side and framework-agnostic; stats arrive as dicts of floats/Meters.
+Behavioral parity target: ``unicore/logging/progress_bar.py`` — the
+``json`` / ``simple`` / ``tqdm`` / ``none`` render formats selected by
+``--log-format``, plus an optional tensorboard mirror with one writer per
+tag.  Independent implementation: iteration bookkeeping and interval
+gating live once in the base class and each renderer only implements the
+two emit hooks (interval line, end-of-epoch summary).
+
+Stats arrive as dicts whose values are numbers, numpy/jax scalars, or
+Meter objects; rendering coerces them on the way out.
 """
 
 import json
 import logging
 import os
 import sys
-from collections import OrderedDict
 from numbers import Number
 
 from .meters import AverageMeter, StopwatchMeter, TimeMeter
@@ -18,64 +22,72 @@ from .meters import AverageMeter, StopwatchMeter, TimeMeter
 logger = logging.getLogger(__name__)
 
 
-def progress_bar(
-    iterator,
-    log_format=None,
-    log_interval=100,
-    epoch=None,
-    prefix=None,
-    tensorboard_logdir=None,
-    default_log_format="tqdm",
-    args=None,
-):
-    if log_format is None:
-        log_format = default_log_format
-    if log_format == "tqdm" and not sys.stderr.isatty():
-        log_format = "simple"
-
-    if log_format == "json":
-        bar = JsonProgressBar(iterator, epoch, prefix, log_interval)
-    elif log_format == "none":
-        bar = NoopProgressBar(iterator, epoch, prefix)
-    elif log_format == "simple":
-        bar = SimpleProgressBar(iterator, epoch, prefix, log_interval)
-    elif log_format == "tqdm":
-        bar = TqdmProgressBar(iterator, epoch, prefix)
-    else:
-        raise ValueError(f"Unknown log format: {log_format}")
-
+def progress_bar(iterator, log_format=None, log_interval=100, epoch=None,
+                 prefix=None, tensorboard_logdir=None,
+                 default_log_format="tqdm", args=None):
+    """Build the renderer selected by ``--log-format``."""
+    fmt = log_format or default_log_format
+    if fmt == "tqdm" and not sys.stderr.isatty():
+        fmt = "simple"
+    renderers = {
+        "json": JsonProgressBar,
+        "simple": SimpleProgressBar,
+        "tqdm": TqdmProgressBar,
+        "none": NoopProgressBar,
+    }
+    if fmt not in renderers:
+        raise ValueError(
+            f"unknown log format {fmt!r}; expected one of {sorted(renderers)}"
+        )
+    bar = renderers[fmt](iterator, epoch=epoch, prefix=prefix,
+                         log_interval=log_interval)
     if tensorboard_logdir:
         bar = TensorboardProgressBarWrapper(bar, tensorboard_logdir, args=args)
-
     return bar
 
 
-def format_stat(stat):
-    if isinstance(stat, Number):
-        stat = "{:g}".format(stat)
-    elif isinstance(stat, AverageMeter):
-        stat = "{:.3f}".format(stat.avg)
-    elif isinstance(stat, TimeMeter):
-        stat = "{:g}".format(round(stat.avg))
-    elif isinstance(stat, StopwatchMeter):
-        stat = "{:g}".format(round(stat.sum))
-    elif hasattr(stat, "item"):
-        stat = "{:g}".format(stat.item())
-    return stat
+def format_stat(value):
+    """Render one stat value as a short string (Meters read their summary)."""
+    if isinstance(value, Number):
+        return f"{value:g}"
+    if isinstance(value, AverageMeter):
+        return f"{value.avg:.3f}"
+    if isinstance(value, TimeMeter):
+        return f"{round(value.avg):g}"
+    if isinstance(value, StopwatchMeter):
+        return f"{round(value.sum):g}"
+    if hasattr(value, "item"):
+        return f"{value.item():g}"
+    return value
+
+
+def _scalar(value):
+    """Coerce a stat to a plain float for tensorboard, or None."""
+    if isinstance(value, AverageMeter):
+        return value.val
+    if isinstance(value, Number):
+        return value
+    if hasattr(value, "item"):
+        return value.item()
+    return None
 
 
 class BaseProgressBar:
-    """Abstract class for progress bars."""
+    """Common machinery: position/size tracking, interval gating, labels."""
 
-    def __init__(self, iterable, epoch=None, prefix=None):
+    def __init__(self, iterable, epoch=None, prefix=None, log_interval=100):
         self.iterable = iterable
-        self.n = getattr(iterable, "n", 0)
+        self.offset = getattr(iterable, "n", 0)
         self.epoch = epoch
-        self.prefix = ""
+        self.log_interval = log_interval
+        self.i = None
+        self.size = None
+        parts = []
         if epoch is not None:
-            self.prefix += f"epoch {epoch:03d}"
+            parts.append(f"epoch {epoch:03d}")
         if prefix is not None:
-            self.prefix += (" | " if self.prefix != "" else "") + prefix
+            parts.append(prefix)
+        self.prefix = " | ".join(parts)
 
     def __len__(self):
         return len(self.iterable)
@@ -87,81 +99,41 @@ class BaseProgressBar:
         return False
 
     def __iter__(self):
-        raise NotImplementedError
-
-    def log(self, stats, tag=None, step=None):
-        """Log intermediate stats according to log_interval."""
-        raise NotImplementedError
-
-    def print(self, stats, tag=None, step=None):
-        """Print end-of-epoch stats."""
-        raise NotImplementedError
-
-    def _str_commas(self, stats):
-        return ", ".join(key + "=" + stats[key].strip() for key in stats.keys())
-
-    def _str_pipes(self, stats):
-        return " | ".join(key + " " + stats[key].strip() for key in stats.keys())
-
-    def _format_stats(self, stats):
-        postfix = OrderedDict(stats)
-        for key in postfix.keys():
-            postfix[key] = str(format_stat(postfix[key]))
-        return postfix
-
-
-class JsonProgressBar(BaseProgressBar):
-    """Log output in JSON format."""
-
-    def __init__(self, iterable, epoch=None, prefix=None, log_interval=1000):
-        super().__init__(iterable, epoch, prefix)
-        self.log_interval = log_interval
-        self.i = None
-        self.size = None
-
-    def __iter__(self):
         self.size = len(self.iterable)
-        for i, obj in enumerate(self.iterable, start=self.n):
+        for i, obj in enumerate(self.iterable, start=self.offset):
             self.i = i
             yield obj
 
+    # renderer hooks ---------------------------------------------------
+
+    def _emit_log(self, rendered):
+        raise NotImplementedError
+
+    def _emit_print(self, rendered):
+        raise NotImplementedError
+
+    # public API -------------------------------------------------------
+
     def log(self, stats, tag=None, step=None):
+        """Emit an intermediate line every ``log_interval`` steps."""
         step = step or self.i or 0
-        if step > 0 and self.log_interval is not None and step % self.log_interval == 0:
-            update = (
-                self.epoch - 1 + (self.i + 1) / float(self.size)
-                if self.epoch is not None
-                else None
-            )
-            stats = self._format_stats(stats, epoch=self.epoch, update=update)
-            logger.info(json.dumps(stats))
+        if (step > 0 and self.log_interval is not None
+                and step % self.log_interval == 0):
+            self._emit_log(self._render(stats))
 
     def print(self, stats, tag=None, step=None):
-        self.stats = stats
-        if tag is not None:
-            self.stats = OrderedDict(
-                [(tag + "_" + k, v) for k, v in self.stats.items()]
-            )
-        stats = self._format_stats(self.stats, epoch=self.epoch)
-        logger.info(json.dumps(stats))
+        """Emit the end-of-epoch summary line."""
+        self._emit_print(self._render(stats))
 
-    def _format_stats(self, stats, epoch=None, update=None):
-        postfix = OrderedDict()
-        if epoch is not None:
-            postfix["epoch"] = epoch
-        if update is not None:
-            postfix["update"] = round(update, 3)
-        for key in stats.keys():
-            postfix[key] = format_stat(stats[key])
-        return postfix
+    def _render(self, stats):
+        return {k: str(format_stat(v)) for k, v in stats.items()}
 
 
 class NoopProgressBar(BaseProgressBar):
-    """No logging."""
+    """Silent renderer for --log-format none."""
 
     def __iter__(self):
-        for obj in self.iterable:
-            yield obj
+        return iter(self.iterable)
 
     def log(self, stats, tag=None, step=None):
         pass
@@ -171,47 +143,52 @@ class NoopProgressBar(BaseProgressBar):
 
 
 class SimpleProgressBar(BaseProgressBar):
-    """A minimal logger for non-TTY environments."""
+    """Plain log lines; the default off-TTY."""
 
-    def __init__(self, iterable, epoch=None, prefix=None, log_interval=1000):
-        super().__init__(iterable, epoch, prefix)
-        self.log_interval = log_interval
-        self.i = None
-        self.size = None
+    def _emit_log(self, rendered):
+        body = ", ".join(f"{k}={v}" for k, v in rendered.items())
+        pos = (self.i + 1) if self.i is not None else 0
+        logger.info("%s:  %5d / %d %s", self.prefix, pos, self.size or 0, body)
 
-    def __iter__(self):
-        self.size = len(self.iterable)
-        for i, obj in enumerate(self.iterable, start=self.n):
-            self.i = i
-            yield obj
+    def _emit_print(self, rendered):
+        body = " | ".join(f"{k} {v}" for k, v in rendered.items())
+        logger.info("%s | %s", self.prefix, body)
+
+
+class JsonProgressBar(BaseProgressBar):
+    """One JSON object per line, with fractional epoch progress."""
 
     def log(self, stats, tag=None, step=None):
         step = step or self.i or 0
-        if step > 0 and self.log_interval is not None and step % self.log_interval == 0:
-            stats = self._format_stats(stats)
-            postfix = self._str_commas(stats)
-            logger.info(
-                "{}:  {:5d} / {:d} {}".format(
-                    self.prefix, self.i + 1, self.size, postfix
-                )
-            )
+        if (step > 0 and self.log_interval is not None
+                and step % self.log_interval == 0):
+            record = {}
+            if self.epoch is not None:
+                record["epoch"] = self.epoch
+                if self.size:
+                    record["update"] = round(
+                        self.epoch - 1 + (self.i + 1) / float(self.size), 3
+                    )
+            record.update((k, format_stat(v)) for k, v in stats.items())
+            logger.info(json.dumps(record))
 
     def print(self, stats, tag=None, step=None):
-        postfix = self._str_pipes(self._format_stats(stats))
-        logger.info(f"{self.prefix} | {postfix}")
+        if tag is not None:
+            stats = {f"{tag}_{k}": v for k, v in stats.items()}
+        record = {} if self.epoch is None else {"epoch": self.epoch}
+        record.update((k, format_stat(v)) for k, v in stats.items())
+        logger.info(json.dumps(record))
 
 
 class TqdmProgressBar(BaseProgressBar):
-    """Log to tqdm."""
+    """Interactive bar for TTY sessions."""
 
-    def __init__(self, iterable, epoch=None, prefix=None):
-        super().__init__(iterable, epoch, prefix)
+    def __init__(self, iterable, epoch=None, prefix=None, log_interval=100):
+        super().__init__(iterable, epoch, prefix, log_interval)
         from tqdm import tqdm
 
         self.tqdm = tqdm(
-            iterable,
-            self.prefix,
-            leave=False,
+            iterable, self.prefix, leave=False,
             disable=(logger.getEffectiveLevel() > logging.INFO),
         )
 
@@ -219,46 +196,62 @@ class TqdmProgressBar(BaseProgressBar):
         return iter(self.tqdm)
 
     def log(self, stats, tag=None, step=None):
-        self.tqdm.set_postfix(self._format_stats(stats), refresh=False)
+        self.tqdm.set_postfix(self._render(stats), refresh=False)
 
     def print(self, stats, tag=None, step=None):
-        postfix = self._str_pipes(self._format_stats(stats))
-        self.tqdm.write(f"{self.tqdm.desc} | {postfix}")
+        body = " | ".join(f"{k} {v}" for k, v in self._render(stats).items())
+        self.tqdm.write(f"{self.tqdm.desc} | {body}")
 
 
-class TensorboardProgressBarWrapper(BaseProgressBar):
-    """Log to tensorboard (one SummaryWriter per tag)."""
+class TensorboardProgressBarWrapper:
+    """Mirror stats into tensorboard (lazy writer per tag), then delegate."""
 
-    def __init__(self, wrapped_bar, tensorboard_logdir, args=None):
+    def __init__(self, wrapped_bar, logdir, args=None):
         self.wrapped_bar = wrapped_bar
-        self.tensorboard_logdir = tensorboard_logdir
+        self.logdir = logdir
         self.args = args
         self._writers = {}
+        self._writer_cls = self._find_writer_cls()
+
+    @staticmethod
+    def _find_writer_cls():
         try:
             from torch.utils.tensorboard import SummaryWriter
-
-            self.SummaryWriter = SummaryWriter
+            return SummaryWriter
         except ImportError:
-            try:
-                from tensorboardX import SummaryWriter
-
-                self.SummaryWriter = SummaryWriter
-            except ImportError:
-                logger.warning(
-                    "tensorboard not found; --tensorboard-logdir will be ignored"
-                )
-                self.SummaryWriter = None
-
-    def _writer(self, key):
-        if self.SummaryWriter is None:
-            return None
-        if key not in self._writers:
-            self._writers[key] = self.SummaryWriter(
-                os.path.join(self.tensorboard_logdir, key)
+            pass
+        try:
+            from tensorboardX import SummaryWriter
+            return SummaryWriter
+        except ImportError:
+            logger.warning(
+                "no tensorboard writer available; --tensorboard-logdir ignored"
             )
+            return None
+
+    def _writer(self, tag):
+        if self._writer_cls is None:
+            return None
+        if tag not in self._writers:
+            w = self._writer_cls(os.path.join(self.logdir, tag))
             if self.args is not None:
-                self._writers[key].add_text("args", str(vars(self.args)))
-        return self._writers[key]
+                w.add_text("args", str(vars(self.args)))
+            self._writers[tag] = w
+        return self._writers[tag]
+
+    def _mirror(self, stats, tag, step):
+        writer = self._writer(tag or "")
+        if writer is None:
+            return
+        if step is None:
+            step = stats.get("num_updates", -1)
+        for key, value in stats.items():
+            if key == "num_updates":
+                continue
+            scalar = _scalar(value)
+            if scalar is not None:
+                writer.add_scalar(key, scalar, step)
+        writer.flush()
 
     def __len__(self):
         return len(self.wrapped_bar)
@@ -266,25 +259,16 @@ class TensorboardProgressBarWrapper(BaseProgressBar):
     def __iter__(self):
         return iter(self.wrapped_bar)
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
     def log(self, stats, tag=None, step=None):
-        self._log_to_tensorboard(stats, tag, step)
+        self._mirror(stats, tag, step)
         self.wrapped_bar.log(stats, tag=tag, step=step)
 
     def print(self, stats, tag=None, step=None):
-        self._log_to_tensorboard(stats, tag, step)
+        self._mirror(stats, tag, step)
         self.wrapped_bar.print(stats, tag=tag, step=step)
-
-    def _log_to_tensorboard(self, stats, tag=None, step=None):
-        writer = self._writer(tag or "")
-        if writer is None:
-            return
-        if step is None:
-            step = stats.get("num_updates", -1)
-        for key in stats.keys() - {"num_updates"}:
-            if isinstance(stats[key], AverageMeter):
-                writer.add_scalar(key, stats[key].val, step)
-            elif isinstance(stats[key], Number):
-                writer.add_scalar(key, stats[key], step)
-            elif hasattr(stats[key], "item"):
-                writer.add_scalar(key, stats[key].item(), step)
-        writer.flush()

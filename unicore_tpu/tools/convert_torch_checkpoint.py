@@ -1,0 +1,70 @@
+"""Convert a reference (torch) Uni-Core checkpoint to this framework's format.
+
+Usage::
+
+    python -m unicore_tpu.tools.convert_torch_checkpoint in.pt out.pt \
+        [--param-map map.json]
+
+Reads the torch checkpoint (zipfile or legacy pickle; reference layout
+``{"model": state_dict, "args": ..., "extra_state": ...}``,
+``unicore/trainer.py:299-325``) on CPU, converts every tensor to numpy,
+and writes a pickled numpy tree.  Model-parameter NAMES are framework
+specific (torch modules vs flax collections), so the output stores the
+flat numpy state dict under ``"torch_model"`` for a model-specific loader
+to consume, optionally pre-renamed via ``--param-map`` (a JSON dict of
+``torch_name -> new_name``).
+"""
+
+import argparse
+import json
+import pickle
+import sys
+
+
+def convert(in_path, out_path, param_map=None):
+    try:
+        import torch
+    except ImportError:
+        raise SystemExit("torch is required to read the input checkpoint")
+    import numpy as np
+
+    state = torch.load(in_path, map_location="cpu", weights_only=False)
+    model = state.get("model", state)
+    flat = {}
+    for name, value in model.items():
+        if param_map and name in param_map:
+            name = param_map[name]
+        if hasattr(value, "numpy"):
+            value = value.float().numpy() if value.dtype.is_floating_point \
+                else value.numpy()
+        flat[name] = np.asarray(value)
+    out = {
+        "torch_model": flat,
+        "extra_state": {
+            k: v for k, v in state.get("extra_state", {}).items()
+            if isinstance(v, (int, float, str, bool, type(None)))
+        },
+        "source": in_path,
+        "format": "unicore_tpu/torch-import/v1",
+    }
+    with open(out_path, "wb") as f:
+        pickle.dump(out, f, protocol=4)
+    print(f"wrote {out_path}: {len(flat)} tensors")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--param-map", default=None,
+                   help="JSON file mapping torch param names to new names")
+    a = p.parse_args(argv)
+    pm = None
+    if a.param_map:
+        with open(a.param_map) as f:
+            pm = json.load(f)
+    convert(a.input, a.output, pm)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

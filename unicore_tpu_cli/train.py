@@ -1,10 +1,15 @@
-"""Train a model on one or more TPU hosts.
+"""``unicore-train``: train a model on one or more TPU hosts.
 
-Parity target: ``unicore_cli/train.py`` (407 LoC) — same epoch loop,
-validation/checkpoint orchestration, early stopping, and async checkpoint
-copy thread.  Differences: no per-GPU process spawning (jax is one process
-per host, SPMD inside), and ``--profile`` wraps the run in
-``jax.profiler.trace`` instead of CUDA nvprof hooks.
+Behavioral parity target: ``unicore_cli/train.py`` — epoch loop with
+curriculum shuffle gating, grad-accum grouping, periodic validation +
+checkpointing, patience-based early stop, and the
+max-update/min-lr/wall-clock stop conditions.  Differences by design: no
+per-GPU process spawning (jax runs one process per host, SPMD inside) and
+``--profile`` wraps the run in ``jax.profiler.trace`` instead of nvprof.
+
+Independent implementation: the loop is a :class:`TrainLoop` object —
+stop conditions, patience state, and the checkpoint manager live on the
+instance instead of function attributes and six-argument call chains.
 """
 
 import argparse
@@ -12,15 +17,15 @@ import logging
 import math
 import os
 import sys
-from multiprocessing.pool import ThreadPool
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
-from unicore_tpu import checkpoint_utils, options, tasks, utils
+from unicore_tpu import options, tasks, utils
+from unicore_tpu.checkpoint_utils import CheckpointManager
 from unicore_tpu.data import iterators
 from unicore_tpu.distributed import utils as distributed_utils
-from unicore_tpu.logging import meters, metrics, progress_bar
+from unicore_tpu.logging import metrics, progress_bar
 from unicore_tpu.trainer import Trainer
 
 logging.basicConfig(
@@ -32,279 +37,273 @@ logging.basicConfig(
 logger = logging.getLogger("unicore_tpu_cli.train")
 
 
-def main(args) -> None:
-    utils.import_user_module(args)
-    assert args.batch_size is not None, (
-        "Must specify batch size either with --batch-size"
-    )
-    metrics.reset()
-    np.random.seed(args.seed)
+class TrainLoop:
+    """Drives epochs: train, validate, checkpoint, decide when to stop."""
 
-    is_master = getattr(args, "distributed_rank", 0) == 0
-    if is_master:
-        checkpoint_utils.verify_checkpoint_directory(args.save_dir)
-        checkpoint_utils.verify_checkpoint_directory(args.tmp_save_dir)
-        ckp_copy_thread = ThreadPool(processes=1)
-    else:
-        ckp_copy_thread = None
+    def __init__(self, args, trainer, task, ckpt: CheckpointManager):
+        self.args = args
+        self.trainer = trainer
+        self.task = task
+        self.ckpt = ckpt
+        self.valid_subsets = args.valid_subset.split(",")
+        # patience tracking (reference should_stop_early, train.py:147-172)
+        self._runs_without_improvement = 0
+        self._patience_best = None
 
-    logger.info(args)
-    task = tasks.setup_task(args)
-    assert args.loss, "Please specify loss to train a model"
-    model = task.build_model(args)
-    loss = task.build_loss(args)
-    for valid_sub_split in args.valid_subset.split(","):
-        task.load_dataset(valid_sub_split, combine=False, epoch=1)
-    logger.info("task: {}".format(task.__class__.__name__))
-    logger.info("model: {}".format(model.__class__.__name__))
-    logger.info("loss: {}".format(loss.__class__.__name__))
+    # -- stop conditions ----------------------------------------------
 
-    trainer = Trainer(args, task, model, loss)
-    logger.info(
-        "training on {} devices".format(trainer.data_parallel_world_size)
-    )
-    logger.info("batch size per host = {}".format(args.batch_size))
-
-    extra_state, epoch_itr = checkpoint_utils.load_checkpoint(
-        args, trainer, disable_iterator_cache=False,
-    )
-
-    max_epoch = args.max_epoch or math.inf
-    lr = trainer.get_lr()
-    train_meter = meters.StopwatchMeter()
-    train_meter.start()
-    while epoch_itr.next_epoch_idx <= max_epoch:
-        if lr <= args.stop_min_lr:
+    def _hit_hard_limits(self):
+        """max-update / wall-clock limits, checked after every step."""
+        updates = self.trainer.get_num_updates()
+        max_update = self.args.max_update or math.inf
+        if updates >= max_update:
             logger.info(
-                f"stopping training because current learning rate ({lr}) is "
-                "smaller than or equal to minimum learning rate "
-                f"(--stop-min-lr={args.stop_min_lr})"
+                "stopping: num_updates %d >= --max-update %s",
+                updates, max_update,
             )
-            break
-        valid_losses, should_stop = train(
-            args, trainer, task, epoch_itr, ckp_copy_thread
+            return True
+        if self.args.stop_time_hours > 0:
+            hours = self.trainer.cumulative_training_time() / 3600.0
+            if hours > self.args.stop_time_hours:
+                logger.info(
+                    "stopping: %.2f training hours > --stop-time-hours %s",
+                    hours, self.args.stop_time_hours,
+                )
+                return True
+        return False
+
+    def _patience_exhausted(self, valid_loss):
+        if valid_loss is None or self.args.patience <= 0:
+            return False
+        better = (
+            self._patience_best is None
+            or (valid_loss > self._patience_best
+                if self.args.maximize_best_checkpoint_metric
+                else valid_loss < self._patience_best)
         )
-        if should_stop:
-            break
-        lr = trainer.lr_step(epoch_itr.epoch, valid_losses[0])
-        epoch_itr = trainer.get_train_iterator(
-            epoch_itr.next_epoch_idx,
-            load_dataset=task.has_sharded_data("train"),
-            disable_iterator_cache=False,
-        )
-    train_meter.stop()
-    if ckp_copy_thread is not None:
-        ckp_copy_thread.close()
-        ckp_copy_thread.join()
-    logger.info("done training in {:.1f} seconds".format(train_meter.sum))
-
-
-def should_stop_early(args, valid_loss: float) -> bool:
-    if valid_loss is None:
-        return False
-    if args.patience <= 0:
-        return False
-
-    def is_better(a, b):
-        return a > b if args.maximize_best_checkpoint_metric else a < b
-
-    prev_best = getattr(should_stop_early, "best", None)
-    if prev_best is None or is_better(valid_loss, prev_best):
-        should_stop_early.best = valid_loss
-        should_stop_early.num_runs = 0
-        return False
-    else:
-        should_stop_early.num_runs += 1
-        if should_stop_early.num_runs >= args.patience:
+        if better:
+            self._patience_best = valid_loss
+            self._runs_without_improvement = 0
+            return False
+        self._runs_without_improvement += 1
+        if self._runs_without_improvement >= self.args.patience:
             logger.info(
-                "early stop since valid performance hasn't improved for "
-                "last {} runs".format(args.patience)
+                "early stop: no validation improvement in the last %d runs",
+                self.args.patience,
             )
             return True
         return False
 
+    # -- epoch loop ----------------------------------------------------
 
-@metrics.aggregate("train")
-def train(args, trainer, task, epoch_itr, ckp_copy_thread):
-    """Train the model for one epoch and return validation losses."""
-    itr = epoch_itr.next_epoch_itr(
-        shuffle=(epoch_itr.next_epoch_idx > args.curriculum),
-    )
-    update_freq = (
-        args.update_freq[epoch_itr.epoch - 1]
-        if epoch_itr.epoch <= len(args.update_freq)
-        else args.update_freq[-1]
-    )
-    itr = iterators.GroupedIterator(itr, update_freq)
-    progress = progress_bar.progress_bar(
-        itr,
-        log_format=args.log_format,
-        log_interval=args.log_interval,
-        epoch=epoch_itr.epoch,
-        tensorboard_logdir=(
-            args.tensorboard_logdir
-            if getattr(args, "distributed_rank", 0) == 0
-            else None
-        ),
-        default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
-    )
-
-    trainer.begin_epoch(epoch_itr.epoch)
-    valid_subsets = args.valid_subset.split(",")
-    should_stop = False
-    num_updates = trainer.get_num_updates()
-    valid_losses = [None]
-    logger.info("Start iterating over samples")
-    for i, samples in enumerate(progress):
-        with metrics.aggregate("train_inner"):
-            log_output = trainer.train_step(samples)
-
-        if log_output is not None:
-            num_updates = trainer.get_num_updates()
-            if num_updates % args.log_interval == 0:
-                stats = get_training_stats(
-                    metrics.get_smoothed_values("train_inner")
+    def run(self, epoch_itr):
+        """Epoch loop until a stop condition fires."""
+        max_epoch = self.args.max_epoch or math.inf
+        lr = self.trainer.get_lr()
+        while epoch_itr.next_epoch_idx <= max_epoch:
+            if lr <= self.args.stop_min_lr:
+                logger.info(
+                    "stopping: lr %g <= --stop-min-lr %g",
+                    lr, self.args.stop_min_lr,
                 )
-                progress.log(stats, tag="train_inner", step=num_updates)
-                metrics.reset_meters("train_inner")
+                break
+            valid_losses, stop = self.train_epoch(epoch_itr)
+            if stop:
+                break
+            lr = self.trainer.lr_step(epoch_itr.epoch, valid_losses[0])
+            epoch_itr = self.trainer.get_train_iterator(
+                epoch_itr.next_epoch_idx,
+                load_dataset=self.task.has_sharded_data("train"),
+                disable_iterator_cache=False,
+            )
 
-        end_of_epoch = not itr.has_next()
-        valid_losses, should_stop = validate_and_save(
-            args, trainer, task, epoch_itr, valid_subsets, end_of_epoch,
-            ckp_copy_thread,
+    @metrics.aggregate("train")
+    def train_epoch(self, epoch_itr):
+        """One epoch of updates; returns (valid_losses, should_stop)."""
+        args = self.args
+        itr = epoch_itr.next_epoch_itr(
+            shuffle=(epoch_itr.next_epoch_idx > args.curriculum),
         )
-        if should_stop:
-            break
-
-    logger.info(
-        "end of epoch {} (average epoch stats below)".format(epoch_itr.epoch)
-    )
-    stats = get_training_stats(metrics.get_smoothed_values("train"))
-    progress.print(stats, tag="train", step=num_updates)
-    metrics.reset_meters("train")
-    return valid_losses, should_stop
-
-
-def validate_and_save(args, trainer, task, epoch_itr, valid_subsets,
-                      end_of_epoch, ckp_copy_thread):
-    num_updates = trainer.get_num_updates()
-    max_update = args.max_update or math.inf
-    should_stop = False
-    if num_updates >= max_update:
-        should_stop = True
-        logger.info(
-            f"Stopping training due to "
-            f"num_updates: {num_updates} >= max_update: {max_update}"
+        freq_schedule = args.update_freq
+        update_freq = (
+            freq_schedule[epoch_itr.epoch - 1]
+            if epoch_itr.epoch <= len(freq_schedule)
+            else freq_schedule[-1]
         )
-    training_time_hours = trainer.cumulative_training_time() / (60 * 60)
-    if args.stop_time_hours > 0 and training_time_hours > args.stop_time_hours:
-        should_stop = True
-        logger.info(
-            f"Stopping training due to "
-            f"cumulative_training_time: {training_time_hours} > "
-            f"stop_time_hours: {args.stop_time_hours} hour(s)"
-        )
+        itr = iterators.GroupedIterator(itr, update_freq)
+        progress = self._progress(itr, epoch_itr.epoch)
 
-    do_save = (
-        (
+        self.trainer.begin_epoch(epoch_itr.epoch)
+        valid_losses, stop = [None], False
+        num_updates = self.trainer.get_num_updates()
+        logger.info("Start iterating over samples")
+        for samples in progress:
+            with metrics.aggregate("train_inner"):
+                log_output = self.trainer.train_step(samples)
+
+            if log_output is not None:
+                num_updates = self.trainer.get_num_updates()
+                if num_updates % args.log_interval == 0:
+                    stats = _with_wall(
+                        metrics.get_smoothed_values("train_inner")
+                    )
+                    progress.log(stats, tag="train_inner", step=num_updates)
+                    metrics.reset_meters("train_inner")
+
+            valid_losses, stop = self.validate_and_save(
+                epoch_itr, end_of_epoch=not itr.has_next()
+            )
+            if stop:
+                break
+
+        logger.info("end of epoch %d (average epoch stats below)",
+                    epoch_itr.epoch)
+        progress.print(
+            _with_wall(metrics.get_smoothed_values("train")),
+            tag="train", step=num_updates,
+        )
+        metrics.reset_meters("train")
+        return valid_losses, stop
+
+    def validate_and_save(self, epoch_itr, end_of_epoch):
+        args = self.args
+        updates = self.trainer.get_num_updates()
+        stop = self._hit_hard_limits()
+
+        # what this round owes: a checkpoint, a validation pass, both, or
+        # neither (reference validate_and_save condition trees,
+        # unicore_cli/train.py:247-320)
+        save_now = stop or (
             end_of_epoch
             and epoch_itr.epoch % args.save_interval == 0
             and not args.no_epoch_checkpoints
-        )
-        or should_stop
-        or (
+        ) or (
             args.save_interval_updates > 0
-            and num_updates > 0
-            and num_updates % args.save_interval_updates == 0
-            and num_updates >= args.validate_after_updates
+            and updates > 0
+            and updates % args.save_interval_updates == 0
+            and updates >= args.validate_after_updates
         )
-    )
-    do_validate = (
-        (not end_of_epoch and do_save)
-        or (
-            end_of_epoch
-            and epoch_itr.epoch % args.validate_interval == 0
-            and not args.no_epoch_checkpoints
+        validate_now = not args.disable_validation and (
+            stop
+            or (not end_of_epoch and save_now)
+            or (
+                end_of_epoch
+                and epoch_itr.epoch % args.validate_interval == 0
+                and not args.no_epoch_checkpoints
+            )
+            or (
+                args.validate_interval_updates > 0
+                and updates > 0
+                and updates % args.validate_interval_updates == 0
+            )
         )
-        or should_stop
-        or (
-            args.validate_interval_updates > 0
-            and num_updates > 0
-            and num_updates % args.validate_interval_updates == 0
+
+        valid_losses = [None]
+        if validate_now:
+            valid_losses = self.validate(epoch_itr)
+        stop |= self._patience_exhausted(valid_losses[0])
+        self.ckpt.save(
+            self.trainer, epoch_itr, valid_losses[0],
+            do_save=(save_now or stop),
         )
-    ) and not args.disable_validation
+        return valid_losses, stop
 
-    valid_losses = [None]
-    if do_validate:
-        valid_losses = validate(args, trainer, task, epoch_itr, valid_subsets)
+    def validate(self, epoch_itr):
+        """Run every validation subset; returns the checkpoint-metric values."""
+        self.task.begin_valid_epoch(epoch_itr.epoch, self.trainer.model)
+        losses = []
+        for subset in self.valid_subsets:
+            logger.info('begin validation on "%s" subset', subset)
+            itr = self.trainer.get_valid_iterator(subset).next_epoch_itr(
+                shuffle=False
+            )
+            progress = self._progress(
+                itr, epoch_itr.epoch, prefix=f"valid on '{subset}' subset"
+            )
+            with metrics.aggregate(new_root=True) as agg:
+                logging_outputs = []
+                for i, sample in enumerate(progress):
+                    if (self.args.max_valid_steps is not None
+                            and i > self.args.max_valid_steps):
+                        break
+                    _, _, sample_logs = self.trainer.valid_step(sample)
+                    logging_outputs.extend(sample_logs)
+                self.task.reduce_metrics(
+                    logging_outputs, self.trainer.loss, subset
+                )
+            stats = self._valid_stats(agg.get_smoothed_values())
+            progress.print(stats, tag=subset,
+                           step=self.trainer.get_num_updates())
+            if self.args.best_checkpoint_metric in stats:
+                losses.append(stats[self.args.best_checkpoint_metric])
+        return losses or [None]
 
-    should_stop |= should_stop_early(args, valid_losses[0])
+    def _valid_stats(self, stats):
+        stats["num_updates"] = self.trainer.get_num_updates()
+        metric = self.args.best_checkpoint_metric
+        if self.ckpt.best.value is not None and metric in stats:
+            fold = max if self.args.maximize_best_checkpoint_metric else min
+            stats[f"best_{metric}"] = fold(self.ckpt.best.value, stats[metric])
+        return stats
 
-    checkpoint_utils.save_checkpoint(
-        args, trainer, epoch_itr, valid_losses[0], ckp_copy_thread,
-        do_save=(do_save or should_stop),
-    )
-    return valid_losses, should_stop
+    def _progress(self, itr, epoch, prefix=None):
+        return progress_bar.progress_bar(
+            itr,
+            log_format=self.args.log_format,
+            log_interval=self.args.log_interval,
+            epoch=epoch,
+            prefix=prefix,
+            tensorboard_logdir=(
+                self.args.tensorboard_logdir
+                if getattr(self.args, "distributed_rank", 0) == 0
+                else None
+            ),
+            default_log_format=(
+                "tqdm" if not self.args.no_progress_bar else "simple"
+            ),
+        )
 
 
-def get_training_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
+def _with_wall(stats):
     stats["wall"] = round(metrics.get_meter("default", "wall").elapsed_time, 0)
     return stats
 
 
-def validate(args, trainer, task, epoch_itr, subsets):
-    """Evaluate the model on the validation set(s) and return the losses."""
-    trainer.task.begin_valid_epoch(epoch_itr.epoch, trainer.model)
-    valid_losses = []
-    for subset in subsets:
-        logger.info('begin validation on "{}" subset'.format(subset))
-        itr = trainer.get_valid_iterator(subset).next_epoch_itr(shuffle=False)
-        progress = progress_bar.progress_bar(
-            itr,
-            log_format=args.log_format,
-            log_interval=args.log_interval,
-            epoch=epoch_itr.epoch,
-            prefix=f"valid on '{subset}' subset",
-            tensorboard_logdir=(
-                args.tensorboard_logdir
-                if getattr(args, "distributed_rank", 0) == 0
-                else None
-            ),
-            default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
-        )
-        with metrics.aggregate(new_root=True) as agg:
-            logging_outputs = []
-            for i, sample in enumerate(progress):
-                if (
-                    args.max_valid_steps is not None
-                    and i > args.max_valid_steps
-                ):
-                    break
-                _, _, inner_logging_outputs = trainer.valid_step(sample)
-                logging_outputs.extend(inner_logging_outputs)
-            task.reduce_metrics(logging_outputs, trainer.loss, subset)
-        stats = get_valid_stats(args, trainer, agg.get_smoothed_values())
-        progress.print(stats, tag=subset, step=trainer.get_num_updates())
-        if args.best_checkpoint_metric in stats:
-            valid_losses.append(stats[args.best_checkpoint_metric])
-    if not valid_losses:
-        valid_losses = [None]
-    return valid_losses
+def main(args) -> None:
+    utils.import_user_module(args)
+    if args.batch_size is None:
+        raise ValueError("--batch-size is required")
+    if not args.loss:
+        raise ValueError("--loss is required to train a model")
+    metrics.reset()
+    np.random.seed(args.seed)
 
+    logger.info(args)
+    task = tasks.setup_task(args)
+    model = task.build_model(args)
+    loss = task.build_loss(args)
+    for subset in args.valid_subset.split(","):
+        task.load_dataset(subset, combine=False, epoch=1)
+    logger.info("task: %s", type(task).__name__)
+    logger.info("model: %s", type(model).__name__)
+    logger.info("loss: %s", type(loss).__name__)
 
-def get_valid_stats(args, trainer, stats: Dict[str, Any]) -> Dict[str, Any]:
-    stats["num_updates"] = trainer.get_num_updates()
-    if (
-        hasattr(checkpoint_utils.save_checkpoint, "best")
-        and args.best_checkpoint_metric in stats
-    ):
-        key = "best_{0}".format(args.best_checkpoint_metric)
-        best_function = max if args.maximize_best_checkpoint_metric else min
-        stats[key] = best_function(
-            checkpoint_utils.save_checkpoint.best,
-            stats[args.best_checkpoint_metric],
-        )
-    return stats
+    trainer = Trainer(args, task, model, loss)
+    logger.info("training on %d devices", trainer.data_parallel_world_size)
+    logger.info("batch size per host = %s", args.batch_size)
+
+    is_master = getattr(args, "distributed_rank", 0) == 0
+    ckpt = CheckpointManager(args, is_master)
+    extra_state, epoch_itr = ckpt.restore(trainer, disable_iterator_cache=False)
+
+    import time
+    started = time.perf_counter()
+    loop = TrainLoop(args, trainer, task, ckpt)
+    try:
+        loop.run(epoch_itr)
+    finally:
+        ckpt.close()
+    logger.info("done training in %.1f seconds", time.perf_counter() - started)
 
 
 def cli_main(modify_parser: Optional[argparse.ArgumentParser] = None) -> None:
@@ -318,7 +317,8 @@ def cli_main(modify_parser: Optional[argparse.ArgumentParser] = None) -> None:
         import jax
 
         with jax.profiler.trace(
-            os.path.join(args.save_dir, "jax_trace"), create_perfetto_link=False
+            os.path.join(args.save_dir, "jax_trace"),
+            create_perfetto_link=False,
         ):
             distributed_utils.call_main(args, main)
     else:
