@@ -1,0 +1,179 @@
+"""Mesh-axis semantics: --fsdp-size shards optimizer/master state (ZeRO)
+and --seq-parallel-size routes attention through ring/Ulysses — both must
+produce the same update as pure data parallelism (VERDICT r1 item 4)."""
+
+from argparse import Namespace
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unicore_tpu import metrics
+from unicore_tpu.distributed import utils as dist_utils
+from unicore_tpu.losses.unicore_loss import UnicoreLoss
+from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.modules import SelfMultiheadAttention
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+VOCAB, DIM, HEADS, SEQ = 16, 32, 4, 8
+
+
+class AttnModel(BaseUnicoreModel):
+    @nn.compact
+    def __call__(self, src_tokens, deterministic=True, **kwargs):
+        x = nn.Embed(VOCAB, DIM, name="embed")(src_tokens)
+        x = x + SelfMultiheadAttention(
+            embed_dim=DIM, num_heads=HEADS, dropout=0.0, name="attn"
+        )(x, deterministic=deterministic)
+        return nn.Dense(VOCAB, name="out")(x)
+
+
+class LMLoss(UnicoreLoss):
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        logits = model.apply(
+            {"params": params}, **sample["net_input"],
+            deterministic=not is_training,
+        )
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        target = sample["target"]
+        nll = -jnp.take_along_axis(lprobs, target[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(nll)
+        n = jnp.asarray(np.prod(target.shape), dtype=jnp.float32)
+        return loss, n, {"loss": loss, "sample_size": n}
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train"):
+        loss = sum(float(l.get("loss", 0)) for l in logging_outputs)
+        n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+        metrics.log_scalar("loss", loss / max(n, 1), n, round=3)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train):
+        return True
+
+
+class _Task(UnicoreTask):
+    pass
+
+
+def make_args(**over):
+    d = dict(
+        seed=1, update_freq=[1], clip_norm=0.0, ema_decay=-1.0,
+        fp16=False, bf16=False, bf16_sr=False,
+        optimizer="adam", lr=[1e-2], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=100, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+    d.update(over)
+    return Namespace(**d)
+
+
+def make_batch(rng, bsz=8):
+    toks = rng.randint(0, VOCAB, size=(bsz, SEQ)).astype(np.int64)
+    return {"net_input": {"src_tokens": toks}, "target": toks.copy()}
+
+
+def run_one_step(batch, n_steps=1, **over):
+    """Fresh mesh + trainer; returns params after n_steps updates."""
+    dist_utils.reset_mesh()
+    args = make_args(**over)
+    task = _Task(args)
+    trainer = Trainer(args, task, AttnModel(), LMLoss(task))
+    metrics.reset()
+    with metrics.aggregate("train"):
+        for _ in range(n_steps):
+            trainer.train_step([batch])
+    return trainer
+
+
+def _assert_params_close(t1, t2, atol):
+    p1 = jax.device_get(t1.state["params"])
+    p2 = jax.device_get(t2.state["params"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol)
+
+
+@pytest.fixture(autouse=True)
+def need_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    yield
+    dist_utils.reset_mesh()
+    from unicore_tpu import parallel
+
+    parallel.disable_sequence_parallel()
+
+
+def _run_on_current_mesh(batch, **over):
+    """Like run_one_step but keeps the pre-installed (restricted) mesh."""
+    args = make_args(**over)
+    task = _Task(args)
+    trainer = Trainer(args, task, AttnModel(), LMLoss(task))
+    metrics.reset()
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+    return trainer
+
+
+def test_one_device_vs_eight_device_update(rng):
+    """The real SPMD invariant: an 8-way sharded step computes the same
+    update as the identical step on a single device."""
+    batch = make_batch(rng, bsz=16)
+    dist_utils.reset_mesh(
+        dist_utils.get_mesh(None, devices=jax.devices()[:1])
+    )
+    t1 = _run_on_current_mesh(batch)
+    dist_utils.reset_mesh()
+    t8 = run_one_step(batch)
+    _assert_params_close(t1, t8, atol=1e-6)
+
+
+def test_fsdp_matches_pure_dp(rng):
+    batch = make_batch(rng, bsz=16)
+    t_dp = run_one_step(batch, n_steps=2)
+    t_fsdp = run_one_step(batch, n_steps=2, fsdp_size=2)
+    _assert_params_close(t_dp, t_fsdp, atol=1e-6)
+
+
+def test_fsdp_actually_shards_state(rng):
+    """Under --fsdp-size the optimizer/master state must be sharded, not
+    replicated (the ZeRO promise of the axis name)."""
+    batch = make_batch(rng, bsz=16)
+    t = run_one_step(batch, fsdp_size=2)
+    sharded = 0
+    for leaf in jax.tree_util.tree_leaves(t.state["opt_state"]):
+        if leaf.ndim >= 1 and not leaf.sharding.is_fully_replicated:
+            shard = leaf.addressable_shards[0].data
+            assert shard.size < leaf.size  # a true shard, not a replica
+            sharded += 1
+    assert sharded > 0, "no optimizer-state leaf is sharded over fsdp"
+    for leaf in jax.tree_util.tree_leaves(t.state["params"]):
+        if leaf.ndim >= 1 and max(leaf.shape) % 2 == 0:
+            assert not leaf.sharding.is_fully_replicated
+            break
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_seq_parallel_matches_pure_dp(rng, impl):
+    batch = make_batch(rng, bsz=16)
+    t_dp = run_one_step(batch)
+    t_sp = run_one_step(batch, seq_parallel_size=2, seq_parallel_impl=impl)
+    # ring/Ulysses online softmax accumulates in a different order than the
+    # fused local softmax: allow small fp32 slack
+    _assert_params_close(t_dp, t_sp, atol=2e-4)
+
+
+def test_seq_parallel_shards_tokens(rng):
+    batch = make_batch(rng, bsz=16)
+    t = run_one_step(batch, seq_parallel_size=2)
+    put = t._to_device(t._prepare_sample_host(batch))
+    spec = put["net_input"]["src_tokens"].sharding.spec
+    assert "seq" in str(spec), spec

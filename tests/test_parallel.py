@@ -85,3 +85,35 @@ def test_ulysses_matches_full(rng, mesh, qkv, causal):
     out = wrapped(q, k, v)
     ref = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_key_padding_mask(rng, mesh, qkv):
+    q, k, v = qkv
+    B, T = q.shape[0], q.shape[1]
+    pad = np.zeros((B, T), dtype=bool)
+    pad[:, T - 10:] = True  # last 10 keys padded
+    ref = full_attention(
+        q, k, v,
+        bias=jnp.where(jnp.asarray(pad)[:, None, None, :], -1e30, 0.0),
+    )
+    out = ring_self_attention(mesh, q, k, v, key_padding_mask=jnp.asarray(pad))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_key_padding_mask_headdim1_bias(rng, mesh, qkv):
+    """Ulysses with a padding mask and NO per-head bias (the case that used
+    to crash on the head-dim-1 slice)."""
+    from unicore_tpu.parallel import ulysses_self_attention
+
+    q, k, v = qkv
+    B, T = q.shape[0], q.shape[1]
+    pad = np.zeros((B, T), dtype=bool)
+    pad[:, T - 6:] = True
+    ref = full_attention(
+        q, k, v,
+        bias=jnp.where(jnp.asarray(pad)[:, None, None, :], -1e30, 0.0),
+    )
+    out = ulysses_self_attention(
+        mesh, q, k, v, key_padding_mask=jnp.asarray(pad)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

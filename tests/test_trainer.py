@@ -217,8 +217,9 @@ def test_checkpoint_roundtrip(rng, tmp_path):
 
 
 def test_multidevice_batch_sharding(rng):
-    """On the 8-device CPU mesh, a sharded batch must give the same update
-    as the single-device result (SPMD grad psum correctness)."""
+    """The batch really is sharded over all devices of the mesh.  The
+    sharded-vs-single-device math invariant lives in
+    tests/test_fsdp_seq.py::test_one_device_vs_eight_device_update."""
     metrics.reset()
     n_dev = len(jax.devices())
     if n_dev < 8:
@@ -227,16 +228,6 @@ def test_multidevice_batch_sharding(rng):
     with metrics.aggregate("train"):
         t1 = make_trainer()
         t1.train_step([batch])
-    # mesh sharding is transparent: params replicated; compare against a
-    # fresh trainer on the same batch (determinism check across runs)
-    with metrics.aggregate("train"):
-        t2 = make_trainer()
-        t2.train_step([batch])
-    p1 = jax.device_get(t1.state["params"])
-    p2 = jax.device_get(t2.state["params"])
-    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
-        np.testing.assert_array_equal(a, b)
-    # and the batch really is sharded over devices
     sharded = t1._to_device(t1._prepare_sample_host(batch))
     tok_sharding = sharded["net_input"]["src_tokens"].sharding
     assert len(tok_sharding.device_set) == n_dev

@@ -78,14 +78,22 @@ def get_mesh(args=None, devices=None):
     by args and consume devices from the data axis."""
     global _MESH
     jax = _jax()
+
+    def requested_sizes(n_devices):
+        tp = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1
+        sp = int(getattr(args, "seq_parallel_size", 1) or 1) if args else 1
+        fsdp = int(getattr(args, "fsdp_size", 1) or 1) if args else 1
+        if args is not None and getattr(args, "fsdp", False) and fsdp == 1:
+            # --fsdp shorthand: every non-tp/sp device goes on the fsdp axis
+            fsdp = n_devices // (tp * sp)
+        return tp, sp, fsdp
+
     if devices is None and _MESH is not None:
         # reuse the cached mesh (and its device subset) when it satisfies
         # the requested axis sizes — callers like dryrun_multichip install
         # a restricted-device mesh that later get_mesh(args) calls must not
         # silently replace
-        tp_r = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1
-        sp_r = int(getattr(args, "seq_parallel_size", 1) or 1) if args else 1
-        fsdp_r = int(getattr(args, "fsdp_size", 1) or 1) if args else 1
+        tp_r, sp_r, fsdp_r = requested_sizes(_MESH.devices.size)
         shape = dict(zip(_MESH.axis_names, _MESH.devices.shape))
         if (
             shape.get("tensor", 1) == tp_r
@@ -96,9 +104,7 @@ def get_mesh(args=None, devices=None):
         devices = list(_MESH.devices.flat)
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    tp = int(getattr(args, "tensor_parallel_size", 1) or 1) if args else 1
-    sp = int(getattr(args, "seq_parallel_size", 1) or 1) if args else 1
-    fsdp = int(getattr(args, "fsdp_size", 1) or 1) if args else 1
+    tp, sp, fsdp = requested_sizes(n)
     assert n % (tp * sp * fsdp) == 0, (
         f"devices ({n}) not divisible by tp*sp*fsdp ({tp}*{sp}*{fsdp})"
     )
@@ -133,6 +139,35 @@ def data_sharding(mesh, ndim=None):
     jax = _jax()
     return jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(("data", "fsdp"))
+    )
+
+
+def fsdp_spec(shape, fsdp_size, axis="fsdp"):
+    """FSDP PartitionSpec for one array: shard the largest dim divisible by
+    the fsdp axis size; replicate arrays with no such dim (tiny biases,
+    scalars).  This is the ZeRO sharding rule for master params + optimizer
+    state."""
+    jax = _jax()
+    P = jax.sharding.PartitionSpec
+    if fsdp_size <= 1 or len(shape) == 0:
+        return P()
+    for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
+        if shape[d] >= fsdp_size and shape[d] % fsdp_size == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def state_sharding(mesh, tree):
+    """Leaf-wise NamedSharding pytree for a TrainState: params/optimizer
+    leaves shard over the ``fsdp`` axis per :func:`fsdp_spec`; everything
+    that cannot shard (step counters, scaler scalars) replicates."""
+    jax = _jax()
+    size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("fsdp", 1)
+    return jax.tree_util.tree_map(
+        lambda x: jax.sharding.NamedSharding(mesh, fsdp_spec(x.shape, size)),
+        tree,
     )
 
 

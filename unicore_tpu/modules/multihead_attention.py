@@ -54,9 +54,69 @@ def _flash_ok(q, k, bias):
     return fa.eligible(qs, ks, None if bias is None else bias.shape)
 
 
+_warned_seq_parallel_dropout = [False]
+
+
+def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias):
+    """Sequence-parallel attention dispatch (mesh ``seq`` axis > 1).
+
+    Returns None when the shapes don't fit the active scheme (sequence or
+    batch not divisible by the mesh axes; self-attention only) — the
+    caller then falls back to local attention.  Attention dropout is NOT
+    applied on this path: the mask would have to be coordinated across the
+    k/v ring, and the reference has no sequence parallelism to set
+    semantics — hidden/FFN dropout still applies (warned once).
+    """
+    import logging
+
+    from unicore_tpu import parallel
+
+    sp = parallel.sequence_parallel()
+    if sp is None:
+        return None
+    mesh, impl = sp
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = shape["seq"]
+    batch_div = shape.get("data", 1) * shape.get("fsdp", 1)
+    t, h = q.shape[1], q.shape[2]
+    if q.shape[1] != k.shape[1] or t % n != 0:
+        return None
+    if q.shape[0] % batch_div != 0:
+        return None  # uneven batch: shard_map would hard-fail
+    if impl == "ulysses" and h % n != 0:
+        return None
+
+    if dropout > 0.0 and not _warned_seq_parallel_dropout[0]:
+        _warned_seq_parallel_dropout[0] = True
+        logging.getLogger(__name__).warning(
+            "sequence-parallel attention ignores attention_dropout=%g "
+            "(dropout masks are not coordinated across the seq axis); "
+            "hidden/FFN dropout still applies", dropout,
+        )
+
+    if key_padding_mask is not None:
+        key_padding_mask = key_padding_mask.astype(bool)
+    if bias is not None:
+        while bias.ndim < 4:
+            bias = bias[None]
+        if bias.shape[2] != t:  # ring shards bias rows; need full [*, *, T, S]
+            bias = jnp.broadcast_to(bias, bias.shape[:2] + (t, bias.shape[3]))
+
+    batch_axes = ("data", "fsdp")
+    attend = (
+        parallel.ulysses_self_attention if impl == "ulysses"
+        else parallel.ring_self_attention
+    )
+    return attend(
+        mesh, q, k, v, bias=bias, key_padding_mask=key_padding_mask,
+        scale=scaling, batch_axes=batch_axes,
+    )
+
+
 def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
             make_rng, return_attn=False):
-    """Core attention: q/k/v are [B, T, H, D].  Dispatches to the flash
+    """Core attention: q/k/v are [B, T, H, D].  Dispatch order: sequence
+    parallelism (when the mesh's ``seq`` axis is active), then the flash
     (blockwise) Pallas kernel on TPU when eligible — the key padding mask
     and (batch-broadcast) bias ride into the kernel separately, so the
     [B, H, q, k] score matrix is never materialized.  The einsum +
@@ -65,6 +125,14 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
     rng = None
     if not deterministic and dropout > 0.0:
         rng = make_rng("dropout")
+
+    if not return_attn and q.shape[1] == k.shape[1]:
+        sp_out = _seq_parallel_attend(
+            q, k, v, scaling, dropout if not deterministic else 0.0,
+            key_padding_mask, bias,
+        )
+        if sp_out is not None:
+            return sp_out
 
     if not return_attn and _flash_ok(q, k, bias):
         from unicore_tpu.ops.pallas.flash_attention import flash_attention

@@ -13,4 +13,38 @@ Two schemes over the mesh's ``seq`` axis:
 """
 
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
-from .ulysses import ulysses_attention  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: F401
+
+# ----------------------------------------------------------------------
+# process-wide sequence-parallel context
+#
+# The Trainer activates this when the mesh's ``seq`` axis is > 1
+# (--seq-parallel-size); attention modules consult it at trace time and
+# dispatch to ring/Ulysses attention instead of local attention.  A
+# context object (not per-module plumbing) because sequence parallelism
+# is a property of the run's mesh, not of any one layer.
+# ----------------------------------------------------------------------
+
+_SEQ_PARALLEL = {"mesh": None, "impl": "ring"}
+
+
+def enable_sequence_parallel(mesh, impl="ring"):
+    """Activate sequence parallelism over ``mesh``'s ``seq`` axis."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+    _SEQ_PARALLEL["mesh"] = mesh
+    _SEQ_PARALLEL["impl"] = impl
+
+
+def disable_sequence_parallel():
+    _SEQ_PARALLEL["mesh"] = None
+
+
+def sequence_parallel():
+    """Return (mesh, impl) when active, else None."""
+    mesh = _SEQ_PARALLEL["mesh"]
+    if mesh is None:
+        return None
+    if dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1) <= 1:
+        return None
+    return mesh, _SEQ_PARALLEL["impl"]

@@ -19,16 +19,21 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, scale, bias_blk, q_offset, k_offset, causal):
+def _block_attend(q, k, v, scale, bias_blk, pad_blk, q_offset, k_offset,
+                  causal):
     """One q-shard x k-shard block: returns (m, l, pv) partials.
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D].  All math fp32.
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; pad_blk: [B, Tk] bool (True =
+    padded key, masked with a finite NEG_INF so empty rows don't NaN).
+    All math fp32.
     """
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
     if bias_blk is not None:
         s = s + bias_blk.astype(jnp.float32)
+    if pad_blk is not None:
+        s = s + jnp.where(pad_blk.astype(bool), NEG_INF, 0.0)[:, None, None, :]
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
@@ -41,13 +46,19 @@ def _block_attend(q, k, v, scale, bias_blk, q_offset, k_offset, causal):
     return m, l, pv
 
 
-def ring_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, bias=None, key_padding_mask=None,
+                   causal=False, scale=None, varying_axes=None):
     """Distributed attention inside shard_map.
 
     q/k/v: [B, T_local, H, D] (the local sequence shard).
     bias: optional [1orB, H, T_local, T_global] — the bias columns for the
     FULL key sequence (each device holds its query rows' bias).
-    Returns [B, T_local, H, D].
+    key_padding_mask: optional [B, T_global] bool (True = pad) — O(T), the
+    per-key-block mask is sliced out each ring step so no [T, T] additive
+    mask is ever materialized.
+    ``varying_axes``: every mesh axis of the enclosing shard_map (the scan
+    carry must be typed device-varying over all of them, not just the
+    ring axis).  Returns [B, T_local, H, D].
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -63,11 +74,19 @@ def ring_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
         src = (idx - step) % n  # which shard's k/v we hold at this step
         return jax.lax.dynamic_slice_in_dim(bias, src * t_local, t_local, axis=3)
 
+    def pad_block(step):
+        if key_padding_mask is None:
+            return None
+        src = (idx - step) % n
+        return jax.lax.dynamic_slice_in_dim(
+            key_padding_mask, src * t_local, t_local, axis=1
+        )
+
     def body(carry, step):
         k_cur, v_cur, m_acc, l_acc, o_acc = carry
         src = (idx - step) % n
         m_b, l_b, pv_b = _block_attend(
-            q, k_cur, v_cur, scale, bias_block(step),
+            q, k_cur, v_cur, scale, bias_block(step), pad_block(step),
             idx * t_local, src * t_local, causal,
         )
         m_new = jnp.maximum(m_acc, m_b)
@@ -79,10 +98,18 @@ def ring_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, m_new, l_new, o_new), None
 
-    # pvary: scan carries must be marked device-varying under shard_map
-    m0 = jax.lax.pvary(jnp.full((b, h, t_local, 1), NEG_INF, dtype=jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, t_local, 1), dtype=jnp.float32), axis_name)
-    o0 = jax.lax.pvary(jnp.zeros((b, h, t_local, d), dtype=jnp.float32), axis_name)
+    # scan carries must be typed device-varying over every shard_map axis
+    axes = tuple(varying_axes) if varying_axes else (axis_name,)
+
+    def vary(x):
+        try:  # pvary is deprecated in favor of pcast
+            return jax.lax.pcast(x, axes, to="varying")
+        except (AttributeError, TypeError):
+            return jax.lax.pvary(x, axes)
+
+    m0 = vary(jnp.full((b, h, t_local, 1), NEG_INF, dtype=jnp.float32))
+    l0 = vary(jnp.zeros((b, h, t_local, 1), dtype=jnp.float32))
+    o0 = vary(jnp.zeros((b, h, t_local, d), dtype=jnp.float32))
     (k_f, v_f, m_f, l_f, o_f), _ = jax.lax.scan(
         body, (k, v, m0, l0, o0), jnp.arange(n)
     )
@@ -92,32 +119,49 @@ def ring_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
     return jnp.transpose(out, (0, 2, 1, 3))  # [B, T_local, H, D]
 
 
-def ring_self_attention(mesh, q, k, v, bias=None, causal=False, scale=None,
-                        axis_name="seq"):
+def ring_self_attention(mesh, q, k, v, bias=None, key_padding_mask=None,
+                        causal=False, scale=None, axis_name="seq",
+                        batch_axes=None):
     """Convenience wrapper: shard q/k/v over ``axis_name`` (sequence dim)
-    and run ring attention via shard_map.  q/k/v: [B, T, H, D] global."""
+    and run ring attention via shard_map.  q/k/v: [B, T, H, D] global;
+    key_padding_mask: [B, T] bool (True = pad), O(T) — never expanded to a
+    [T, T] additive mask.
+
+    ``batch_axes``: mesh axes the batch dim is already sharded over (e.g.
+    ``("data", "fsdp")`` inside the trainer's SPMD step) — without it,
+    shard_map would silently all-gather the batch."""
     from jax.sharding import PartitionSpec as P
 
-    qkv_spec = P(None, axis_name, None, None)
-    bias_spec = P(None, None, axis_name, None) if bias is not None else None
-    out_spec = P(None, axis_name, None, None)
-
-    fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, scale=scale
-    )
-
-    if bias is not None:
-        wrapped = jax.shard_map(
-            lambda q_, k_, v_, b_: fn(q_, k_, v_, bias=b_),
-            mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
-            out_specs=out_spec,
+    qkv_spec = P(batch_axes, axis_name, None, None)
+    out_spec = P(batch_axes, axis_name, None, None)
+    varying = (axis_name,)
+    if batch_axes:
+        varying = varying + (
+            (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes)
         )
-        return wrapped(q, k, v, bias)
-    wrapped = jax.shard_map(
-        lambda q_, k_, v_: fn(q_, k_, v_),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=out_spec,
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, scale=scale,
+        varying_axes=varying,
     )
-    return wrapped(q, k, v)
+
+    operands = [q, k, v]
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    kw_order = []
+    if bias is not None:
+        operands.append(bias)
+        in_specs.append(
+            P(batch_axes if bias.shape[0] > 1 else None, None, axis_name, None)
+        )
+        kw_order.append("bias")
+    if key_padding_mask is not None:
+        operands.append(key_padding_mask)
+        in_specs.append(P(batch_axes, None))  # full key mask on every device
+        kw_order.append("key_padding_mask")
+
+    def call(q_, k_, v_, *extras):
+        return fn(q_, k_, v_, **dict(zip(kw_order, extras)))
+
+    wrapped = jax.shard_map(
+        call, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_spec
+    )
+    return wrapped(*operands)

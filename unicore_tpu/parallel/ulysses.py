@@ -16,12 +16,16 @@ import jax
 import jax.numpy as jnp
 
 
-def _local_attention(q, k, v, bias, causal, scale):
+def _local_attention(q, k, v, bias, key_padding_mask, causal, scale):
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if key_padding_mask is not None:
+        s = s + jnp.where(
+            key_padding_mask.astype(bool), -1e30, 0.0
+        )[:, None, None, :]
     if causal:
         t = q.shape[1]
         m = jnp.triu(jnp.full((t, t), -1e30, dtype=jnp.float32), k=1)
@@ -31,10 +35,12 @@ def _local_attention(q, k, v, bias, causal, scale):
     return o.astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
+def ulysses_attention(q, k, v, axis_name, bias=None, key_padding_mask=None,
+                      causal=False, scale=None):
     """Inside shard_map: q/k/v [B, T_local, H, D] sequence shards; returns
-    the same layout.  ``bias``: [1orB, H_local_after, T, T] is NOT resharded
-    (pass per-head-shard bias if needed)."""
+    the same layout.  ``bias``: full [1orB, H, T, T]; each device slices
+    out its head block (head-dim-1 biases broadcast instead).
+    ``key_padding_mask``: [B, T] bool (True = pad), full key axis."""
     n = jax.lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
     assert h % n == 0, f"heads ({h}) must divide seq-parallel size ({n})"
@@ -58,9 +64,50 @@ def ulysses_attention(q, k, v, axis_name, bias=None, causal=False, scale=None):
         return x.reshape(b, t // n, h, d)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
-    if bias is not None:
-        # shard bias heads to this device's head block
+    if bias is not None and bias.shape[1] > 1:
+        # shard bias heads to this device's head block (head-dim-1 biases
+        # broadcast over every head, nothing to slice)
         hidx = jax.lax.axis_index(axis_name)
         bias = jax.lax.dynamic_slice_in_dim(bias, hidx * (h // n), h // n, axis=1)
-    o = _local_attention(qh, kh, vh, bias, causal, scale)
+    o = _local_attention(qh, kh, vh, bias, key_padding_mask, causal, scale)
     return head2seq(o)
+
+
+def ulysses_self_attention(mesh, q, k, v, bias=None, key_padding_mask=None,
+                           causal=False, scale=None, axis_name="seq",
+                           batch_axes=None):
+    """shard_map wrapper over :func:`ulysses_attention`; q/k/v [B, T, H, D]
+    global, sequence dim sharded over ``axis_name``.  ``bias`` (if any) is
+    full [1orB, H, T, T]; each device slices out its head block inside.
+    ``key_padding_mask``: [B, T] bool (True = pad).
+    ``batch_axes``: mesh axes the batch dim is sharded over."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(batch_axes, axis_name, None, None)
+    fn = functools.partial(
+        ulysses_attention, axis_name=axis_name, causal=causal, scale=scale
+    )
+
+    operands = [q, k, v]
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    kw_order = []
+    if bias is not None:
+        operands.append(bias)
+        in_specs.append(
+            P(batch_axes if bias.shape[0] > 1 else None, None, None, None)
+        )
+        kw_order.append("bias")
+    if key_padding_mask is not None:
+        operands.append(key_padding_mask)
+        in_specs.append(P(batch_axes, None))
+        kw_order.append("key_padding_mask")
+
+    def call(q_, k_, v_, *extras):
+        return fn(q_, k_, v_, **dict(zip(kw_order, extras)))
+
+    wrapped = jax.shard_map(
+        call, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec
+    )
+    return wrapped(*operands)

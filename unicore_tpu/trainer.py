@@ -43,6 +43,7 @@ from unicore_tpu.distributed import (
     get_mesh,
     replicated,
     shard_batch,
+    state_sharding,
 )
 from unicore_tpu.optim import build_optimizer
 from unicore_tpu.optim.dynamic_loss_scaler import scaler_init, scaler_update
@@ -77,6 +78,21 @@ class Trainer:
         self.data_parallel_rank = get_data_parallel_rank()
         self.data_parallel_world_size = get_data_parallel_world_size()
         self.is_data_parallel_master = self.data_parallel_rank == 0
+        self._mesh_shape = dict(
+            zip(self.mesh.axis_names, self.mesh.devices.shape)
+        )
+
+        # activate sequence parallelism for this run's mesh: attention
+        # modules consult the context at trace time and dispatch to
+        # ring/Ulysses over the ``seq`` axis
+        from unicore_tpu import parallel
+
+        if self._mesh_shape.get("seq", 1) > 1:
+            parallel.enable_sequence_parallel(
+                self.mesh, getattr(args, "seq_parallel_impl", None) or "ring"
+            )
+        else:
+            parallel.disable_sequence_parallel()
 
         self.update_freq = (
             args.update_freq[0]
@@ -132,8 +148,11 @@ class Trainer:
         if self.ema_decay > 0:
             # real copies: aliasing params would break buffer donation
             state["ema"] = jax.tree_util.tree_map(jnp.copy, params)
-        # replicate over the mesh (pure DP: params live on every device)
-        self.state = jax.device_put(state, replicated(self.mesh))
+        # pure DP: every leaf replicates; --fsdp-size > 1: master params,
+        # optimizer state, and EMA shard leaf-wise over the fsdp axis
+        # (ZeRO) while scalars (step, scaler) stay replicated
+        self._state_shardings = state_sharding(self.mesh, state)
+        self.state = jax.device_put(state, self._state_shardings)
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
         logger.info(
             "num. model params: {:,} (compute dtype: {})".format(
@@ -184,6 +203,7 @@ class Trainer:
         scale_window = self.scale_window
         min_loss_scale = float(getattr(self.args, "min_loss_scale", 1e-4))
         optimizer = self.optimizer
+        state_shardings = self._state_shardings
 
         def train_step(state, batches, weights, lr, rng):
             scale = state["scaler"]["scale"] if use_scaler else jnp.float32(1.0)
@@ -218,6 +238,12 @@ class Trainer:
             # (reference: multiply_grads(world/sample_size), trainer.py:695-709)
             denom = jnp.maximum(sample_size, 1.0) * scale
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            # ZeRO: constrain grads to the fsdp sharding so XLA emits a
+            # reduce-scatter (not all-reduce) and the optimizer update runs
+            # on each device's param shard only
+            grads = jax.lax.with_sharding_constraint(
+                grads, state_shardings["params"]
+            )
 
             grad_norm = utils.global_norm(grads)
             if clip_norm > 0:
@@ -257,6 +283,9 @@ class Trainer:
                 )
                 new_state["ema"] = keep(new_ema, state["ema"])
 
+            new_state = jax.lax.with_sharding_constraint(
+                new_state, {k: state_shardings[k] for k in new_state}
+            )
             stats = {
                 "sample_size": sample_size,
                 "grad_norm": grad_norm,
@@ -433,9 +462,9 @@ class Trainer:
         return batches, jnp.asarray(weights, dtype=jnp.float32)
 
     def _to_device(self, batch, stacked_micro=False):
-        sharding = data_sharding(self.mesh)
         rep = replicated(self.mesh)
         multihost = jax.process_count() > 1
+        seq_size = self._mesh_shape.get("seq", 1)
 
         def put(x):
             x = np.asarray(x)
@@ -444,11 +473,17 @@ class Trainer:
             if multihost:
                 n_local_shards //= jax.process_count()
             if x.ndim > dim and x.shape[dim] % max(n_local_shards, 1) == 0:
-                if stacked_micro:
-                    spec = jax.sharding.PartitionSpec(None, ("data", "fsdp"))
-                    s = jax.sharding.NamedSharding(self.mesh, spec)
-                else:
-                    s = sharding
+                spec = [None] * x.ndim
+                spec[dim] = ("data", "fsdp")
+                # sequence parallelism: split the token dim over ``seq`` so
+                # embeddings come out sharded and attention's shard_map sees
+                # its expected layout
+                if (seq_size > 1 and x.ndim > dim + 1
+                        and x.shape[dim + 1] % seq_size == 0):
+                    spec[dim + 1] = "seq"
+                s = jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(*spec)
+                )
                 if multihost:
                     # each host holds its own shard of the global batch
                     # (the iterator sharded by process rank); assemble the
@@ -680,7 +715,8 @@ class Trainer:
                 )
             if self.ema_decay > 0:
                 fresh["ema"] = jax.tree_util.tree_map(jnp.copy, params)
-            self.state = jax.device_put(fresh, replicated(self.mesh))
+            self._state_shardings = state_sharding(self.mesh, fresh)
+            self.state = jax.device_put(fresh, self._state_shardings)
         else:
             if getattr(self.args, "load_from_ema", False) and "ema" in state:
                 # reference --load-from-ema (trainer.py:388-392): start from
@@ -689,5 +725,6 @@ class Trainer:
                 state["params"] = jax.tree_util.tree_map(
                     jnp.copy, state["ema"]
                 )
-            self.state = jax.device_put(state, replicated(self.mesh))
+            self._state_shardings = state_sharding(self.mesh, state)
+            self.state = jax.device_put(state, self._state_shardings)
             self._num_updates = int(state_np["step"])
