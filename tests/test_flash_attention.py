@@ -135,3 +135,28 @@ def test_module_dispatch_equivalence(rng):
     np.testing.assert_allclose(
         np.asarray(o_ref), np.asarray(o_flash), atol=5e-5
     )
+
+
+def test_flash_dropout_row_seed_global_identity(rng, qkv):
+    """Per-row dropout seeds carry global row identity: a shard computing
+    rows [2:4] with batch_seed_offset=2 must reproduce the full batch's
+    rows [2:4] exactly — and a shard without the offset must NOT (this is
+    the per-shard mask decorrelation under data sharding)."""
+    q, k, v = qkv
+    q4 = jnp.concatenate([q, q], axis=0)  # B=4, rows 2:4 duplicate 0:2
+    k4 = jnp.concatenate([k, k], axis=0)
+    v4 = jnp.concatenate([v, v], axis=0)
+    key = jax.random.PRNGKey(11)
+    full = flash_attention(q4, k4, v4, dropout_prob=0.3, rng=key,
+                           is_training=True)
+    shard_hi = flash_attention(q, k, v, dropout_prob=0.3, rng=key,
+                               is_training=True, batch_seed_offset=2)
+    np.testing.assert_allclose(
+        np.asarray(full[2:4]), np.asarray(shard_hi), atol=1e-6
+    )
+    shard_lo = flash_attention(q, k, v, dropout_prob=0.3, rng=key,
+                               is_training=True)
+    # identical inputs, different global rows -> different masks
+    assert not np.allclose(np.asarray(shard_lo), np.asarray(shard_hi))
+    # and within one call, duplicate rows get different masks too
+    assert not np.allclose(np.asarray(full[:2]), np.asarray(full[2:4]))

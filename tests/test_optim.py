@@ -288,3 +288,30 @@ def test_grads_finite():
     bad = {"a": jnp.asarray([1.0, jnp.inf, 0.0]), "b": jnp.zeros((2, 2))}
     assert bool(grads_finite(good))
     assert not bool(grads_finite(bad))
+
+
+def test_sr_cast_straight_through_gradient():
+    """--bf16-sr's in-loss cast: value is the SR rounding, gradient is
+    identity to the fp32 master."""
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.optim.fp16_optimizer import _sr_cast_straight_through
+
+    x = jnp.linspace(-3.0, 3.0, 64, dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def f(x):
+        return jnp.sum(_sr_cast_straight_through(x, key).astype(jnp.float32))
+
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(x), atol=0)
+    out = _sr_cast_straight_through(x, key)
+    assert out.dtype == jnp.bfloat16
+    # value matches the raw SR op
+    from unicore_tpu.ops import fp32_to_bf16_sr
+
+    np.testing.assert_array_equal(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(fp32_to_bf16_sr(x, key), dtype=np.float32),
+    )

@@ -78,10 +78,15 @@ def _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k):
     return s
 
 
-def _mb_seed(seed_ref, b, h, i, j, n_h, n_i, n_j):
-    """Per-(batch, head, q-block, k-block) seed — identical across the
-    forward and all backward passes regardless of their grid layouts."""
-    return seed_ref[0] + ((b * n_h + h) * n_i + i) * n_j + j
+def _mb_seed(seed_ref, h, i, j, n_i, n_j):
+    """Per-(head, q-block, k-block) offset on this batch row's seed —
+    identical across the forward and all backward passes regardless of
+    their grid layouts.  The batch dependence lives in the per-row seed
+    array (``seed_ref`` is this row's block), which carries GLOBAL row
+    identity so data-sharded shards derive decorrelated masks (the
+    analogue of the reference's per-rank dropout seed scoping,
+    trainer.py:610-616)."""
+    return seed_ref[0] + (h * n_i + i) * n_j + j
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
@@ -91,7 +96,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
     pad_ref = refs.pop(0) if has_pad else None
     out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
 
-    b, h = pl.program_id(0), pl.program_id(1)
+    h = pl.program_id(1)
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -115,7 +120,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
 
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         p_use = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
     else:
@@ -145,7 +150,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     pad_ref = refs.pop(0) if has_pad else None
     dk_ref, dv_ref, dk_scr, dv_scr = refs
 
-    b, h = pl.program_id(0), pl.program_id(1)
+    h = pl.program_id(1)
     j, i = pl.program_id(2), pl.program_id(3)  # grid: k blocks outer, q inner
 
     @pl.when(i == 0)
@@ -165,7 +170,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         p_drop = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
     else:
@@ -204,7 +209,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     pad_ref = refs.pop(0) if has_pad else None
     dq_ref, dq_scr = refs
 
-    b, h = pl.program_id(0), pl.program_id(1)
+    h = pl.program_id(1)
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -226,7 +231,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     )
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
     ds = p * (dp - delta)
@@ -273,7 +278,7 @@ def _dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     )
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, b, h, i, j, n_h, n_q, n_k)
+        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
     scr[...] += p * (dp - delta)
@@ -323,7 +328,13 @@ def _lse_spec(block_q):
                         memory_space=pltpu.VMEM)
 
 
-_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+def _seed_spec(imap):
+    """Per-batch-row seed block ([B] int32 array; each grid step sees its
+    row's seed in SMEM)."""
+    return pl.BlockSpec((1,), imap, memory_space=pltpu.SMEM)
+
+
+_SEED_SPEC = _seed_spec(lambda b, *_: (b,))  # any grid with batch as axis 0
 
 
 def _common(q, k, causal):
@@ -487,7 +498,8 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
                                  memory_space=pltpu.VMEM)
         lse_spec_b = pl.BlockSpec((1, 1, block_q, 1), hmap4("lse"),
                                   memory_space=pltpu.VMEM)
-        db_in = [_SEED_SPEC, q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
+        db_in = [_seed_spec(lambda h, i, j, b: (b,)),
+                 q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
                  lse_spec_b, lse_spec_b]
         db_args = [seed, q, k, v, g, lse, delta]
         bB, bH, bQ, bK = bias.shape
@@ -545,10 +557,17 @@ def flash_attention(
     rng=None,
     is_training=True,
     scale=None,
+    batch_seed_offset=None,
 ):
     """Blockwise attention.  q/k/v: [B, T, H, D] (module layout); ``bias``
     broadcastable to [B, H, Tq, Tk]; ``key_padding_mask``: [B, Tk] with
-    nonzero = pad.  Returns [B, Tq, H, D]."""
+    nonzero = pad.  Returns [B, Tq, H, D].
+
+    Dropout seeds are PER BATCH ROW (base seed + global row id x odd
+    constant), so data-sharded invocations under one jit derive
+    decorrelated masks.  ``batch_seed_offset`` lets an explicit-SPMD
+    caller (shard_map) pass its shard's global row origin
+    (``axis_index * local_batch``)."""
     bsz, tq, heads, d = q.shape
     if scale is None:
         scale = d ** -0.5
@@ -561,9 +580,15 @@ def flash_attention(
     if p > 0.0:
         if rng is None:
             raise ValueError("flash_attention: rng required for dropout")
-        seed = jax.random.randint(rng, (1,), 0, 2 ** 31 - 1, dtype=jnp.int32)
+        base = jax.random.randint(rng, (), 0, 2 ** 31 - 1, dtype=jnp.int32)
+        rows = jax.lax.iota(jnp.int32, bsz)
+        if batch_seed_offset is not None:
+            rows = rows + jnp.asarray(batch_seed_offset, dtype=jnp.int32)
+        # Knuth multiplicative-hash constant (odd): distinct rows land in
+        # well-separated seed neighborhoods mod 2^32
+        seed = base + rows * jnp.int32(-1640531527)
     else:
-        seed = jnp.zeros((1,), dtype=jnp.int32)
+        seed = jnp.zeros((bsz,), dtype=jnp.int32)
     pad = None
     if key_padding_mask is not None:
         pad = key_padding_mask.astype(jnp.int32)[:, None, :]  # [B, 1, Tk]

@@ -30,16 +30,33 @@ def make_master_params(params):
     return jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
 
 
+def _sr_cast_straight_through(master_leaf, key):
+    """fp32 -> bf16 stochastic-rounding cast with a straight-through
+    gradient (d(out)/d(master) = 1).
+
+    The SR op itself is bit-twiddling (non-differentiable); the trainer
+    applies this cast INSIDE the differentiated loss (the functional
+    analogue of the reference's post-step master->model SR sync,
+    fp16_optimizer.py:146-148), so gradients must flow through to the
+    fp32 master as identity — exactly what autograd-through-a-cast does
+    in the reference."""
+    sr = ops.fp32_to_bf16_sr(master_leaf, key).astype(jnp.float32)
+    return (
+        master_leaf + jax.lax.stop_gradient(sr - master_leaf)
+    ).astype(jnp.bfloat16)
+
+
 def sync_master_to_model(master, model_dtype, sr_rng=None):
     """Cast the fp32 master copy to the model dtype, optionally with
     stochastic rounding (reference ``_sync_fp32_params_to_fp16``,
-    fp16_optimizer.py:140-150)."""
+    fp16_optimizer.py:140-150).  Differentiable: the SR path uses a
+    straight-through gradient."""
     if model_dtype == jnp.float32:
         return master
     if sr_rng is not None and model_dtype == jnp.bfloat16:
         leaves, treedef = jax.tree_util.tree_flatten(master)
         keys = jax.random.split(sr_rng, len(leaves))
-        out = [ops.fp32_to_bf16_sr(l, k) for l, k in zip(leaves, keys)]
+        out = [_sr_cast_straight_through(l, k) for l, k in zip(leaves, keys)]
         return jax.tree_util.tree_unflatten(treedef, out)
     return jax.tree_util.tree_map(lambda p: p.astype(model_dtype), master)
 

@@ -51,6 +51,7 @@ from unicore_tpu.optim.fp16_optimizer import (
     default_scale_window,
     grads_finite,
     make_master_params,
+    sync_master_to_model,
 )
 from unicore_tpu.optim.lr_scheduler import build_lr_scheduler
 
@@ -73,6 +74,11 @@ class Trainer:
             self.compute_dtype = jnp.bfloat16
         self.use_scaler = self.compute_dtype == jnp.float16
         self.bf16_sr = bool(getattr(args, "bf16_sr", False))
+        if self.bf16_sr and self.compute_dtype != jnp.bfloat16:
+            raise ValueError(
+                "--bf16-sr requires --bf16 (stochastic rounding applies to "
+                "the fp32->bf16 master->model cast only)"
+            )
 
         self.mesh = get_mesh(args)
         self.data_parallel_rank = get_data_parallel_rank()
@@ -183,10 +189,21 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _loss_for_microbatch(self, params_f32, batch, rng, weight, scale):
-        """Scaled, weighted micro-batch loss; returns aux for logging."""
-        params = jax.tree_util.tree_map(
-            lambda p: p.astype(self.compute_dtype), params_f32
-        )
+        """Scaled, weighted micro-batch loss; returns aux for logging.
+
+        The master->compute cast applies stochastic rounding under
+        ``--bf16-sr`` (straight-through gradient; the functional analogue
+        of the reference's post-step SR sync, fp16_optimizer.py:146-148,
+        with a per-microbatch rng instead of a fixed post-step seed)."""
+        if self.bf16_sr and self.compute_dtype == jnp.bfloat16:
+            params = sync_master_to_model(
+                params_f32, self.compute_dtype,
+                sr_rng=jax.random.fold_in(rng, 0x5F1C),
+            )
+        else:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype), params_f32
+            )
         loss, sample_size, logging_output = self.task.loss_and_metrics(
             self.model, self.loss, params, batch, rng, is_training=True
         )
