@@ -303,3 +303,82 @@ def test_bf16_sr_training_differs_from_plain_bf16(rng):
     )
     np.testing.assert_array_equal(flat(p_sr1), flat(p_sr2))
     assert not np.array_equal(flat(p_sr1), flat(p_plain))
+
+
+class NonSummableLoss(ToyLoss):
+    """Logging outputs must NOT be summed across micro-batches."""
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train):
+        return False
+
+
+def make_nonsummable_trainer(**over):
+    args = make_args(**over)
+    task = ToyTask(args)
+    return Trainer(args, task, ToyModel(), NonSummableLoss(task))
+
+
+def test_nonsummable_logging_outputs_per_microbatch(rng):
+    """When logging_outputs_can_be_summed is False the trainer must hand
+    reduce_metrics one dict per real micro-batch, not a single sum
+    (VERDICT r1 item 7)."""
+    metrics.reset()
+    t = make_nonsummable_trainer(update_freq=[3])
+    b1, b2 = make_batch(rng, bsz=4), make_batch(rng, bsz=4)
+    with metrics.aggregate("train"):
+        logs = t.train_step([b1, b2])  # 3rd slot is a dummy (weight 0)
+    assert len(logs) == 2  # one per REAL micro-batch, dummy dropped
+    # each entry carries its own micro-batch stats, unsummed
+    for entry in logs:
+        assert float(entry["bsz"]) == 4.0
+    # and the math matches the summable path: same data, same params
+    metrics.reset()
+    t2 = make_trainer(update_freq=[3])
+    with metrics.aggregate("train"):
+        logs2 = t2.train_step([b1, b2])
+    assert len(logs2) == 1 and float(logs2[0]["bsz"]) == 8.0
+    p1 = jax.device_get(t.state["params"])
+    p2 = jax.device_get(t2.state["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_all_gather_objects_single_process():
+    from unicore_tpu.distributed import all_gather_objects
+
+    obj = {"loss": 1.5, "ids": [1, 2, 3]}
+    assert all_gather_objects(obj) == [obj]
+
+
+def test_per_sample_clip_norm(rng):
+    """--per-sample-clip-norm clips each example's gradient before
+    accumulation (reference unicore_optimizer.py:110-130, redesigned to
+    true per-example granularity under SPMD)."""
+    metrics.reset()
+    batch = make_batch(rng, bsz=4)
+    # tiny threshold: every per-example grad is scaled down, so the final
+    # update must differ from the unclipped run and the effective global
+    # grad norm must be bounded by bsz * threshold / sample_size-norm
+    with metrics.aggregate("train"):
+        t_clip = make_trainer(per_sample_clip_norm=1e-3)
+        logs_c = t_clip.train_step([batch])
+        t_plain = make_trainer()
+        logs_p = t_plain.train_step([batch])
+    # losses identical (clipping affects grads, not the forward)
+    np.testing.assert_allclose(
+        float(logs_c[0]["loss"]), float(logs_p[0]["loss"]), rtol=1e-5
+    )
+    p_c = jax.device_get(t_clip.state["params"])
+    p_p = jax.device_get(t_plain.state["params"])
+    flat = lambda p: np.concatenate(
+        [np.ravel(np.asarray(l)) for l in jax.tree_util.tree_leaves(p)]
+    )
+    assert not np.allclose(flat(p_c), flat(p_p))
+    # huge threshold: clipping is a no-op and must match plain exactly
+    metrics.reset()
+    with metrics.aggregate("train"):
+        t_noop = make_trainer(per_sample_clip_norm=1e9)
+        t_noop.train_step([batch])
+    p_n = jax.device_get(t_noop.state["params"])
+    np.testing.assert_allclose(flat(p_n), flat(p_p), atol=1e-6)

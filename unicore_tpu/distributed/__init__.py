@@ -12,6 +12,7 @@ only for host-side control-plane data.
 """
 
 from .utils import (  # noqa: F401
+    all_gather_objects,
     call_main,
     data_sharding,
     distributed_init,

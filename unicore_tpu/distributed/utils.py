@@ -180,6 +180,35 @@ def shard_batch(batch, mesh):
     )
 
 
+def all_gather_objects(obj):
+    """Gather one picklable host object from every process; returns the
+    list ordered by process index.
+
+    The analogue of the reference's ``all_gather_list``
+    (``unicore/distributed/utils.py:305-375``): pickle into a byte
+    buffer, pad to the max length across processes, allgather, unpickle.
+    Host-side control-plane only — device data rides shardings/psum.
+    Single-process: returns ``[obj]`` without touching the network."""
+    jax = _jax()
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], dtype=np.int64)
+    ).reshape(-1)
+    padded = np.zeros(int(sizes.max()), dtype=np.uint8)
+    padded[: payload.size] = payload
+    table = multihost_utils.process_allgather(padded)
+    return [
+        pickle.loads(table[p, : int(sizes[p])].tobytes())
+        for p in range(jax.process_count())
+    ]
+
+
 def call_main(args, main, **kwargs):
     """Single-program entry (parity: ``distributed_utils.call_main``,
     utils.py:170).  No process spawning: jax addresses all local devices

@@ -20,3 +20,8 @@ from .transformer_decoder import (  # noqa: F401
     TransformerDecoderLayer,
     future_mask,
 )
+from .triangle_attention import (  # noqa: F401
+    EvoformerPairBlock,
+    PairTransition,
+    TriangleAttention,
+)

@@ -106,6 +106,9 @@ class Trainer:
             else getattr(args, "update_freq", 1)
         )
         self.clip_norm = float(getattr(args, "clip_norm", 0.0) or 0.0)
+        self.per_sample_clip_norm = float(
+            getattr(args, "per_sample_clip_norm", 0.0) or 0.0
+        )
         self.ema_decay = float(getattr(args, "ema_decay", -1) or -1)
         self.seed = int(getattr(args, "seed", 1))
 
@@ -221,22 +224,86 @@ class Trainer:
         min_loss_scale = float(getattr(self.args, "min_loss_scale", 1e-4))
         optimizer = self.optimizer
         state_shardings = self._state_shardings
+        # fast path (reference trainer.py:973-1055): summable logging
+        # outputs accumulate inside the scan; non-summable ones come back
+        # stacked per micro-batch and are unpacked host-side
+        sum_logs = self._logs_summable(is_train=True)
+        psc = self.per_sample_clip_norm
+        if psc > 0 and not sum_logs:
+            raise ValueError(
+                "--per-sample-clip-norm requires summable logging outputs "
+                "(per-example logs are accumulated inside the step)"
+            )
 
         def train_step(state, batches, weights, lr, rng):
             scale = state["scaler"]["scale"] if use_scaler else jnp.float32(1.0)
+
+            def grads_per_sample_clipped(batch, mb_rng, w):
+                """Per-EXAMPLE gradients, each clipped to psc, then summed.
+
+                The reference clips per (micro-batch, rank) unit before
+                grad sync (unicore_optimizer.py:110-130); under SPMD
+                there are no per-rank grads, so the TPU-native granularity
+                is the true per-sample one.  Sequential scan over the
+                batch keeps memory at one grad pytree (B backward passes:
+                this flag is opt-in for small-batch molecular workloads).
+                """
+                def one(carry, xs_ex):
+                    example, ex_idx = xs_ex
+                    g_acc, ss_acc, logs_acc = carry
+                    ex = jax.tree_util.tree_map(lambda x: x[None], example)
+                    # per-example rng: without the fold_in every example
+                    # would draw the identical dropout mask
+                    ex_rng = jax.random.fold_in(mb_rng, ex_idx)
+                    (_, (ss_e, logs_e)), g = jax.value_and_grad(
+                        self._loss_for_microbatch, has_aux=True
+                    )(state["params"], ex, ex_rng, w, scale)
+                    # clip threshold applies to the UNSCALED grad norm
+                    gn = utils.global_norm(g) / scale
+                    coef = jnp.minimum(1.0, psc / (gn + 1e-6))
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, x: a + x.astype(jnp.float32) * coef,
+                        g_acc, g,
+                    )
+                    logs_acc = jax.tree_util.tree_map(
+                        lambda a, l: a + l, logs_acc, logs_e
+                    )
+                    return (g_acc, ss_acc + ss_e, logs_acc), None
+
+                z_g = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+                )
+                z_l = jax.tree_util.tree_map(
+                    lambda _: jnp.zeros((), jnp.float32), self._logging_proto
+                )
+                n_examples = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                (g, ss, logs), _ = jax.lax.scan(
+                    one, (z_g, jnp.zeros((), jnp.float32), z_l),
+                    (batch, jnp.arange(n_examples)),
+                )
+                return g, ss, logs
 
             def micro(carry, xs):
                 grads_acc, ss_acc, logs_acc = carry
                 batch, w, idx = xs
                 mb_rng = jax.random.fold_in(rng, idx)
-                (_, (ss, logs)), grads = jax.value_and_grad(
-                    self._loss_for_microbatch, has_aux=True
-                )(state["params"], batch, mb_rng, w, scale)
+                if psc > 0:
+                    grads, ss, logs = grads_per_sample_clipped(batch, mb_rng, w)
+                else:
+                    (_, (ss, logs)), grads = jax.value_and_grad(
+                        self._loss_for_microbatch, has_aux=True
+                    )(state["params"], batch, mb_rng, w, scale)
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
                 )
-                logs_acc = jax.tree_util.tree_map(lambda a, l: a + l, logs_acc, logs)
-                return (grads_acc, ss_acc + ss, logs_acc), None
+                if sum_logs:
+                    logs_acc = jax.tree_util.tree_map(
+                        lambda a, l: a + l, logs_acc, logs
+                    )
+                    ys = None
+                else:
+                    ys = logs
+                return (grads_acc, ss_acc + ss, logs_acc), ys
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
@@ -245,11 +312,12 @@ class Trainer:
                 lambda _: jnp.zeros((), jnp.float32), self._logging_proto
             )
             n_micro = weights.shape[0]
-            (grads, sample_size, logs), _ = jax.lax.scan(
+            (grads, sample_size, summed_logs), stacked_logs = jax.lax.scan(
                 micro,
                 (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
                 (batches, weights, jnp.arange(n_micro)),
             )
+            logs = summed_logs if sum_logs else stacked_logs
 
             # unscale + normalize by the GLOBAL sample size in one multiply
             # (reference: multiply_grads(world/sample_size), trainer.py:695-709)
@@ -346,7 +414,7 @@ class Trainer:
         if self.state is None:
             self.init_state(samples[0])
 
-        batches, weights = self._stack_microbatches(samples)
+        batches, weights_np = self._stack_microbatches(samples)
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
             self._logging_proto_cached = None
@@ -356,7 +424,7 @@ class Trainer:
             jax.random.PRNGKey(self.seed), self.get_num_updates()
         )
         self.state, stats = self._jit_train_step(
-            self.state, batches, weights, lr, rng
+            self.state, batches, jnp.asarray(weights_np), lr, rng
         )
 
         # host-side bookkeeping (one device->host sync per step for stats)
@@ -391,7 +459,9 @@ class Trainer:
         else:
             self.set_num_updates(self.get_num_updates() + 1)
 
-        logging_outputs = [dict(stats["logs"])]
+        logging_outputs = self._unpack_logging_outputs(
+            stats["logs"], weights_np, is_train=True
+        )
         sample_size = float(stats["sample_size"])
         if not overflow:
             self._reduce_and_log_stats(
@@ -417,6 +487,36 @@ class Trainer:
     # ------------------------------------------------------------------
     # batching helpers
     # ------------------------------------------------------------------
+
+    def _logs_summable(self, is_train):
+        # route through the task hook (overridable per-task; delegates to
+        # the loss by default — tasks/unicore_task.py)
+        fn = getattr(self.task, "logging_outputs_can_be_summed", None)
+        if fn is not None:
+            return bool(fn(self.loss, is_train))
+        fn = getattr(self.loss, "logging_outputs_can_be_summed", None)
+        return True if fn is None else bool(fn(is_train))
+
+    def _unpack_logging_outputs(self, logs, weights_np, is_train):
+        """Turn the compiled step's logging pytree into the list of dicts
+        ``reduce_metrics`` expects.
+
+        Summable losses (the fast path) already accumulated inside the
+        step -> one dict.  Non-summable losses come back stacked per
+        micro-batch -> one dict per real (weight > 0) micro-batch, dummy
+        lockstep slots dropped.  No cross-host gather is needed in either
+        case: under single-program SPMD every logging value is computed
+        from the GLOBAL batch, so each host already holds the global
+        result (the reference's pickle ``all_gather_list``,
+        distributed/utils.py:305-375, exists for per-rank host objects —
+        that surface is ``distributed.all_gather_objects``)."""
+        if self._logs_summable(is_train):
+            return [dict(logs)]
+        return [
+            {k: np.asarray(v)[i] for k, v in logs.items()}
+            for i in range(len(weights_np))
+            if weights_np[i] > 0
+        ]
 
     @property
     def _logging_proto(self):
@@ -476,7 +576,25 @@ class Trainer:
 
         stacked = jax.tree_util.tree_map(stack, *prepared)
         batches = self._to_device(stacked, stacked_micro=True)
-        return batches, jnp.asarray(weights, dtype=jnp.float32)
+        weights = np.asarray(weights, dtype=np.float32)
+        if jax.process_count() > 1:
+            # SPMD lockstep: the weights array is a replicated input, so
+            # every host MUST feed identical values.  At a ragged epoch
+            # tail some hosts hold a real batch where others hold a dummy
+            # — a slot counts only if every host has real data there
+            # (cost: at most world_size-1 batches per epoch, logged).
+            from jax.experimental import multihost_utils
+
+            table = multihost_utils.process_allgather(weights)
+            agreed = np.asarray(table).reshape(-1, weights.shape[0]).min(axis=0)
+            dropped = int((weights - agreed).sum())
+            if dropped:
+                logger.info(
+                    "dropping %d ragged-tail micro-batch(es) to keep hosts "
+                    "in lockstep", dropped,
+                )
+            weights = agreed
+        return batches, weights
 
     def _to_device(self, batch, stacked_micro=False):
         rep = replicated(self.mesh)
