@@ -1,6 +1,11 @@
 """Flash attention kernel vs materialized reference — fwd, grads (incl.
 bias), padding, causal, dropout statistics, and module-level dispatch
-equivalence.  Runs in interpret mode on CPU."""
+equivalence.  Runs in interpret mode on CPU; with
+UNICORE_TPU_TEST_ON_TPU=1 it compiles and runs on the real chip, where
+tolerances widen to MXU fp32 matmul precision (inputs pass through
+bf16 lanes, so independent accumulation orders differ at ~1e-4)."""
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -11,6 +16,13 @@ from unicore_tpu.ops.backend import kernel_backend
 from unicore_tpu.ops.pallas.flash_attention import eligible, flash_attention
 
 B, T, H, D = 2, 256, 4, 64
+
+ON_TPU = os.environ.get("UNICORE_TPU_TEST_ON_TPU", "") == "1"
+# On the chip the error model is relative (MXU bf16-lane passes), so
+# tolerance is rtol-led; in interpret mode both sides are exact fp32 and
+# atol-led tight bounds apply.
+FWD_TOL = dict(rtol=2e-2, atol=5e-3) if ON_TPU else dict(atol=2e-5)
+GRAD_TOL = dict(rtol=2e-2, atol=2e-2) if ON_TPU else dict(atol=5e-4)
 
 
 def ref_attn(q, k, v, bias=None, pad=None, causal=False, scale=None):
@@ -50,7 +62,7 @@ def test_flash_forward_parity(rng, qkv, case):
         kw["causal"] = refkw["causal"] = True
     out = flash_attention(q, k, v, is_training=False, **kw)
     ref = ref_attn(q, k, v, **refkw)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **FWD_TOL)
 
 
 def test_flash_grad_parity(rng, qkv):
@@ -73,7 +85,7 @@ def test_flash_grad_parity(rng, qkv):
     g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(q, k, v, bias)
     for name, a, b in zip("q k v bias".split(), g1, g2):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=name
+            np.asarray(a), np.asarray(b), err_msg=name, **GRAD_TOL
         )
 
 
@@ -133,7 +145,7 @@ def test_module_dispatch_equivalence(rng):
         o_flash = attn.apply(params, x, key_padding_mask=jnp.asarray(pad),
                              attn_bias=bias)
     np.testing.assert_allclose(
-        np.asarray(o_ref), np.asarray(o_flash), atol=5e-5
+        np.asarray(o_ref), np.asarray(o_flash), **FWD_TOL
     )
 
 

@@ -1,0 +1,93 @@
+"""TPU compile-smoke for every Pallas kernel.
+
+Round-exit gate (VERDICT r2 item 3): interpret-mode tests cannot see
+Mosaic lowering errors, so each kernel's fwd+bwd must be compiled on the
+real chip before a round ships.  Exits non-zero naming the first kernel
+that fails.
+
+Usage: python tools/tpu_smoke.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _smoke_flash():
+    from unicore_tpu.ops.pallas import flash_attention as fa
+
+    assert fa.kernel_self_check(), "flash-attention kernel failed to lower"
+
+
+def _smoke_layer_norm():
+    from unicore_tpu.ops.pallas.layer_norm import layer_norm
+
+    x = jnp.zeros((8, 256, 768), jnp.bfloat16)
+    w = jnp.ones((768,), jnp.float32)
+    b = jnp.zeros((768,), jnp.float32)
+
+    def f(x, w, b):
+        return jnp.sum(layer_norm(x, w, b).astype(jnp.float32))
+
+    jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(x, w, b).compile()
+
+
+def _smoke_softmax_dropout():
+    from unicore_tpu.ops.pallas.softmax_dropout import softmax_dropout
+
+    x = jnp.zeros((2, 4, 256, 256), jnp.float32)
+    bias = jnp.zeros((1, 4, 256, 256), jnp.float32)
+    mask = jnp.zeros((2, 1, 1, 256), jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def f(x, bias):
+        return jnp.sum(
+            softmax_dropout(x, 0.1, rng=key, is_training=True,
+                            mask=mask, bias=bias)
+        )
+
+    jax.jit(jax.grad(f, argnums=(0, 1))).lower(x, bias).compile()
+
+
+def _smoke_rounding():
+    from unicore_tpu.ops.pallas.rounding import fp32_to_bf16_sr
+
+    x = jnp.zeros((1024, 256), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    jax.jit(fp32_to_bf16_sr).lower(x, key).compile()
+
+
+def main():
+    backend = jax.default_backend()
+    print(f"backend: {backend} ({jax.devices()[0].device_kind})")
+    if backend != "tpu" and "--allow-cpu" not in sys.argv:
+        # interpret mode proves nothing about Mosaic lowering — a gate
+        # that silently passes on a CPU fallback is not a gate
+        print("SMOKE FAILED: not on TPU (pass --allow-cpu to override)")
+        return 1
+    failures = []
+    for name, fn in [
+        ("flash_attention", _smoke_flash),
+        ("layer_norm", _smoke_layer_norm),
+        ("softmax_dropout", _smoke_softmax_dropout),
+        ("fp32_to_bf16_sr", _smoke_rounding),
+    ]:
+        try:
+            fn()
+            print(f"PASS {name}")
+        except Exception as e:  # noqa: BLE001
+            print(f"FAIL {name}: {type(e).__name__}: {str(e)[:500]}")
+            failures.append(name)
+    if failures:
+        print(f"SMOKE FAILED: {failures}")
+        return 1
+    print("SMOKE OK: all Pallas kernels compile on this backend")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,21 @@
 """Masked-LM loss (parity: ``unicore/losses/masked_lm.py``).
 
 The reference gathers the masked positions with a dynamic boolean index
-(``target[masked_tokens]``) — a dynamic shape jit cannot trace.  The
-TPU-native form is the weighted full-sequence loss: every position computes
-its nll, masked by ``target != pad``; identical sums, static shapes
-(SURVEY §7 "hard parts").  The model still receives ``masked_tokens`` so it
-can cheapen the vocab projection with a fixed-capacity gather if it wants.
+(``target[masked_tokens]``) — a dynamic shape jit cannot trace.  Two
+TPU-native forms are supported, chosen by what the model returns:
+
+- ``[B, T, V]`` array: weighted full-sequence loss — every position
+  computes its nll, masked by ``target != pad``; identical sums, static
+  shapes (SURVEY §7 "hard parts").
+- ``{logits, slot_index, slot_valid}`` dict (the static-capacity analogue
+  of the reference's masked-token-only projection,
+  ``examples/bert/model.py:183-194``): ``logits`` is ``[K, V]`` over K
+  fixed slots, ``slot_index`` maps slots into the flat ``[B*T]`` sequence,
+  ``slot_valid`` marks slots holding real masked positions.  CONTRACT:
+  the loss sums nll over valid slots and ``sample_size = sum(slot_valid)``
+  — if more than K positions are masked, the overflow is dropped from
+  BOTH the numerator and the denominator, so the per-token normalization
+  stays exact (VERDICT r2 weak-5).
 """
 
 import math
@@ -28,18 +38,31 @@ class MaskedLMLoss(UnicoreLoss):
         masked_tokens = target != self.padding_idx  # [B, T] bool, static shape
         sample_size = jnp.sum(masked_tokens.astype(jnp.float32))
 
-        logits = model.apply(
+        out = model.apply(
             {"params": params},
             **sample["net_input"],
             masked_tokens=masked_tokens,
             deterministic=not is_training,
             rngs={"dropout": rng} if (is_training and rng is not None) else None,
         )
-        # logits: [B, T, V] (full-sequence head; weighted-mask loss)
-        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        tgt = jnp.where(masked_tokens, target, 0)
-        nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
-        loss = jnp.sum(nll * masked_tokens.astype(nll.dtype))
+        if isinstance(out, dict):
+            # static-slot head: logits [K, V] over gathered masked positions
+            logits = out["logits"]
+            slot_index = out["slot_index"]
+            slot_valid = out["slot_valid"]
+            flat_tgt = jnp.where(masked_tokens, target, 0).reshape(-1)
+            tgt = flat_tgt[slot_index]  # [K]
+            lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(lprobs, tgt[:, None], axis=-1)[:, 0]
+            w = slot_valid.astype(nll.dtype)
+            loss = jnp.sum(nll * w)
+            sample_size = jnp.sum(w)
+        else:
+            # logits: [B, T, V] (full-sequence head; weighted-mask loss)
+            lprobs = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+            tgt = jnp.where(masked_tokens, target, 0)
+            nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
+            loss = jnp.sum(nll * masked_tokens.astype(nll.dtype))
 
         bsz, seq_len = target.shape[0], target.shape[1]
         logging_output = {

@@ -43,7 +43,7 @@ def _padding_bias(key_padding_mask, dtype):
     )
 
 
-def _flash_ok(q, k, bias):
+def _flash_ok(q, k, bias, has_pad, dropout_on):
     from unicore_tpu.ops.backend import use_pallas
     from unicore_tpu.ops.pallas import flash_attention as fa
 
@@ -51,7 +51,17 @@ def _flash_ok(q, k, bias):
         return False
     qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
     ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
-    return fa.eligible(qs, ks, None if bias is None else bias.shape)
+    if not fa.eligible(qs, ks, None if bias is None else bias.shape):
+        return False
+    # fail-open: compile-probe THIS config once per process (dtype/seq
+    # lens/bias kind change the BlockSpecs); if it doesn't lower on this
+    # backend, use the materialized path instead of crashing training
+    return fa.probe_ok(
+        q.dtype, q.shape[1], k.shape[1], q.shape[3],
+        None if bias is None else bias.shape[2],
+        None if bias is None else bias.dtype,
+        has_pad, False, dropout_on,
+    )
 
 
 _warned_seq_parallel_dropout = [False]
@@ -134,7 +144,9 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
         if sp_out is not None:
             return sp_out
 
-    if not return_attn and _flash_ok(q, k, bias):
+    if not return_attn and _flash_ok(
+        q, k, bias, key_padding_mask is not None, rng is not None
+    ):
         from unicore_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(

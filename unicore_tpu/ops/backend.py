@@ -63,3 +63,38 @@ def pallas_interpret():
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.InterpretParams()
+
+
+_PROBE_CACHE = {}
+
+
+def kernel_probe_ok(key, builder):
+    """FAIL-OPEN dispatch guard: compile a tiny representative probe of a
+    Pallas kernel once per distinct config and cache the outcome.
+
+    Interpret-mode tests cannot see Mosaic lowering errors (the round-2
+    bench died on exactly that), so each kernel dispatch site calls this
+    with a ``key`` capturing everything that affects lowering (dtype,
+    block shapes, broadcast kinds) and a ``builder`` that lowers+compiles
+    a minimal config with identical BlockSpecs (grid size does not affect
+    lowering, so lead/batch dims shrink to 1).  On failure the caller
+    falls back to the jnp reference path instead of crashing training."""
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if pallas_interpret():  # interpret mode: nothing lowers, nothing to probe
+        _PROBE_CACHE[key] = True
+        return True
+    import logging
+
+    try:
+        builder()
+        ok = True
+    except Exception as e:  # noqa: BLE001 — any lowering failure disables
+        logging.getLogger(__name__).warning(
+            "Pallas kernel probe %r failed to compile; using the jnp "
+            "reference path for this config: %s", key, str(e)[:2000],
+        )
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok

@@ -10,9 +10,10 @@ the TPU analogue is a lane-multiple constraint (last dim % 128 == 0) for the
 Pallas path, with the jnp path covering everything else.
 """
 
+import jax
 import jax.numpy as jnp
 
-from .backend import use_pallas
+from .backend import kernel_probe_ok, use_pallas
 
 
 def layer_norm_reference(x, weight=None, bias=None, eps=1e-5):
@@ -41,5 +42,25 @@ def layer_norm(x, weight=None, bias=None, eps=1e-5):
     ):
         from .pallas import layer_norm as pl_impl
 
-        return pl_impl.layer_norm(x, weight, bias, eps=eps)
+        dim = x.shape[-1]
+        r_blk = pl_impl._pick_r_blk(rows, dim)
+        probe_key = ("layer_norm", x.dtype.name, dim, r_blk,
+                     weight.dtype.name, bias.dtype.name)
+
+        def build():
+            # one grid step with the production BlockSpec (rows = r_blk
+            # re-picks the same block); grad covers the bwd kernel
+            px = jnp.zeros((r_blk, dim), x.dtype)
+            w = jnp.zeros((dim,), weight.dtype)
+            b = jnp.zeros((dim,), bias.dtype)
+
+            def f(px, w, b):
+                return jnp.sum(
+                    pl_impl.layer_norm(px, w, b, eps=eps).astype(jnp.float32)
+                )
+
+            jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(px, w, b).compile()
+
+        if kernel_probe_ok(probe_key, build):
+            return pl_impl.layer_norm(x, weight, bias, eps=eps)
     return layer_norm_reference(x, weight=weight, bias=bias, eps=eps)

@@ -78,15 +78,16 @@ def _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k):
     return s
 
 
-def _mb_seed(seed_ref, h, i, j, n_i, n_j):
+def _mb_seed(seed_ref, b, h, i, j, n_i, n_j):
     """Per-(head, q-block, k-block) offset on this batch row's seed —
     identical across the forward and all backward passes regardless of
-    their grid layouts.  The batch dependence lives in the per-row seed
-    array (``seed_ref`` is this row's block), which carries GLOBAL row
-    identity so data-sharded shards derive decorrelated masks (the
-    analogue of the reference's per-rank dropout seed scoping,
-    trainer.py:610-616)."""
-    return seed_ref[0] + (h * n_i + i) * n_j + j
+    their grid layouts.  ``seed_ref`` is the FULL [B] seed array in SMEM
+    (unblocked — Mosaic rejects rank-1 (1,) blocks whose length isn't a
+    lane multiple), indexed here by the grid's batch id.  The per-row
+    seeds carry GLOBAL row identity so data-sharded shards derive
+    decorrelated masks (the analogue of the reference's per-rank dropout
+    seed scoping, trainer.py:610-616)."""
+    return seed_ref[b] + (h * n_i + i) * n_j + j
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
@@ -96,7 +97,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
     pad_ref = refs.pop(0) if has_pad else None
     out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
 
-    h = pl.program_id(1)
+    b, h = pl.program_id(0), pl.program_id(1)
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -120,7 +121,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, has_bias, has_pad,
 
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         p_use = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
     else:
@@ -150,7 +151,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     pad_ref = refs.pop(0) if has_pad else None
     dk_ref, dv_ref, dk_scr, dv_scr = refs
 
-    h = pl.program_id(1)
+    b, h = pl.program_id(0), pl.program_id(1)
     j, i = pl.program_id(2), pl.program_id(3)  # grid: k blocks outer, q inner
 
     @pl.when(i == 0)
@@ -170,7 +171,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         p_drop = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
     else:
@@ -209,7 +210,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     pad_ref = refs.pop(0) if has_pad else None
     dq_ref, dq_scr = refs
 
-    h = pl.program_id(1)
+    b, h = pl.program_id(0), pl.program_id(1)
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -231,7 +232,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     )
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
     ds = p * (dp - delta)
@@ -278,7 +279,7 @@ def _dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     )
     if dropout_prob > 0.0:
         keep_prob = 1.0 - dropout_prob
-        seed = _mb_seed(seed_ref, h, i, j, n_q, n_k)
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
         keep = keep_mask(seed, p.shape, keep_prob)
         dp = jnp.where(keep, dp * (1.0 / keep_prob), 0.0)
     scr[...] += p * (dp - delta)
@@ -292,6 +293,64 @@ def _pick_blocks(tq, tk):
     bq = 256 if tq % 256 == 0 else (128 if tq % 128 == 0 else tq)
     bk = 512 if tk % 512 == 0 else (128 if tk % 128 == 0 else tk)
     return bq, bk
+
+
+def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
+             dropout_on):
+    """FAIL-OPEN compile probe for one flash config (round-2 lesson: a
+    kernel that doesn't lower must fall back to the einsum path, not kill
+    training).  Keyed on everything that affects Mosaic lowering — q/kv
+    dtype, seq lens (they fix the block sizes), head dim, bias kind
+    (``bias_q`` is None / 1 / tq — the bQ==1 sublane-1 block is its own
+    spec) and bias dtype, pad mask presence, causal, dropout.  The probe
+    shrinks batch/heads to 1: grid size does not affect lowering,
+    BlockSpecs are identical."""
+    from unicore_tpu.ops.backend import kernel_probe_ok
+
+    dtype = jnp.dtype(dtype)
+    bias_dtype = None if bias_q is None else jnp.dtype(bias_dtype)
+    key = ("flash", dtype.name, tq, tk, d, bias_q,
+           None if bias_dtype is None else bias_dtype.name,
+           has_pad, causal, dropout_on)
+
+    def build():
+        q = jnp.zeros((1, tq, 1, d), dtype)
+        kv = jnp.zeros((1, tk, 1, d), dtype)
+        pad = jnp.zeros((1, tk), jnp.int32) if has_pad else None
+        rng = jax.random.PRNGKey(0) if dropout_on else None
+        dp = 0.1 if dropout_on else 0.0
+        kw = dict(key_padding_mask=pad, causal=causal, dropout_prob=dp,
+                  rng=rng, is_training=dropout_on)
+        if bias_q is None:
+            def f(q, kv):
+                o = flash_attention(q, kv, kv, **kw)
+                return jnp.sum(o.astype(jnp.float32))
+
+            jax.jit(jax.grad(f, argnums=(0, 1))).lower(q, kv).compile()
+        else:
+            bias = jnp.zeros((1, 1, bias_q, tk), bias_dtype)
+
+            def f(q, kv, bias):
+                o = flash_attention(q, kv, kv, bias=bias, **kw)
+                return jnp.sum(o.astype(jnp.float32))
+
+            jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(q, kv, bias).compile()
+
+    return kernel_probe_ok(key, build)
+
+
+def kernel_self_check():
+    """Compile-smoke the production-critical spec variants (used by
+    ``tools/tpu_smoke.py`` and available for startup checks): BERT-like
+    bf16 bias+pad+dropout, the bQ==1 broadcast-bias block, and causal."""
+    return (
+        probe_ok(jnp.bfloat16, 512, 512, 64, 512, jnp.bfloat16, True, False,
+                 True)
+        and probe_ok(jnp.float32, 256, 256, 64, 1, jnp.float32, False, False,
+                     False)
+        and probe_ok(jnp.float32, 256, 256, 64, None, None, False, True,
+                     False)
+    )
 
 
 def eligible(q_shape, k_shape, bias_shape):
@@ -328,13 +387,11 @@ def _lse_spec(block_q):
                         memory_space=pltpu.VMEM)
 
 
-def _seed_spec(imap):
-    """Per-batch-row seed block ([B] int32 array; each grid step sees its
-    row's seed in SMEM)."""
-    return pl.BlockSpec((1,), imap, memory_space=pltpu.SMEM)
-
-
-_SEED_SPEC = _seed_spec(lambda b, *_: (b,))  # any grid with batch as axis 0
+# The full [B] int32 per-row seed array rides into SMEM unblocked (no
+# block shape / index map); kernels index it by the grid's batch id.
+# A (1,)-blocked rank-1 spec is NOT portable: Mosaic requires rank-1
+# block lengths to equal the array length or be a 128-multiple.
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _common(q, k, causal):
@@ -498,7 +555,7 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
                                  memory_space=pltpu.VMEM)
         lse_spec_b = pl.BlockSpec((1, 1, block_q, 1), hmap4("lse"),
                                   memory_space=pltpu.VMEM)
-        db_in = [_seed_spec(lambda h, i, j, b: (b,)),
+        db_in = [_SEED_SPEC,
                  q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
                  lse_spec_b, lse_spec_b]
         db_args = [seed, q, k, v, g, lse, delta]

@@ -19,6 +19,18 @@ _LANE = 1024
 _SUBLANE = 8
 
 
+def pick_layout(n):
+    """(rows, r_blk) for an n-element flat array: rows padded to a sublane
+    multiple of [rows, _LANE] tiles, block = 256 rows when divisible else
+    one sublane.  Shared by the kernel and the dispatch wrapper's
+    compile-probe so the probed BlockSpec can never drift from the real
+    one."""
+    rows = -(-n // _LANE)
+    rows = -(-rows // _SUBLANE) * _SUBLANE
+    r_blk = 256 if rows % 256 == 0 else _SUBLANE
+    return rows, r_blk
+
+
 def _kernel(seed_ref, x_ref, out_ref):
     x = x_ref[...]
     seed = seed_ref[0] + pl.program_id(0)
@@ -35,14 +47,12 @@ def fp32_to_bf16_sr(x, rng):
     shape = x.shape
     n = x.size
     # pad to [rows, _LANE] with rows a sublane multiple for clean tiling
-    rows = -(-n // _LANE)
-    rows = -(-rows // _SUBLANE) * _SUBLANE
+    rows, r_blk = pick_layout(n)
     flat = jnp.zeros((rows * _LANE,), dtype=jnp.float32).at[:n].set(
         x.astype(jnp.float32).ravel()
     )
     x2d = flat.reshape(rows, _LANE)
     seed = jax.random.randint(rng, (1,), 0, 2**31 - 1, dtype=jnp.int32)
-    r_blk = 256 if rows % 256 == 0 else _SUBLANE
     out = pl.pallas_call(
         _kernel,
         grid=(rows // r_blk,),

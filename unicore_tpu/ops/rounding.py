@@ -33,7 +33,18 @@ def fp32_to_bf16_sr_reference(x, rng):
 
 def fp32_to_bf16_sr(x, rng):
     if use_pallas():
+        from .backend import kernel_probe_ok
         from .pallas import rounding as pl_impl
 
-        return pl_impl.fp32_to_bf16_sr(x, rng)
+        _, r_blk = pl_impl.pick_layout(x.size)
+
+        def build():
+            # rows = r_blk re-picks the same block → identical BlockSpec
+            px = jnp.zeros((r_blk * pl_impl._LANE,), jnp.float32)
+            jax.jit(pl_impl.fp32_to_bf16_sr).lower(
+                px, jax.random.PRNGKey(0)
+            ).compile()
+
+        if kernel_probe_ok(("fp32_to_bf16_sr", r_blk), build):
+            return pl_impl.fp32_to_bf16_sr(x, rng)
     return fp32_to_bf16_sr_reference(x, rng)

@@ -70,9 +70,12 @@ def softmax_dropout(
     if use_pallas() and not return_softmax and _pallas_eligible(x, mask, bias):
         from .pallas import softmax_dropout as pl_impl
 
-        return pl_impl.softmax_dropout(
-            x, dropout_prob, rng=rng, is_training=is_training, mask=mask, bias=bias
-        )
+        dropout_on = is_training and float(dropout_prob) > 0.0
+        if _probe_ok(x, mask, bias, dropout_on):
+            return pl_impl.softmax_dropout(
+                x, dropout_prob, rng=rng, is_training=is_training,
+                mask=mask, bias=bias,
+            )
     return softmax_dropout_reference(
         x,
         dropout_prob,
@@ -82,6 +85,55 @@ def softmax_dropout(
         bias=bias,
         return_softmax=return_softmax,
     )
+
+
+def _probe_ok(x, mask, bias, dropout_on):
+    """FAIL-OPEN compile probe keyed on everything affecting Mosaic
+    lowering: dtype, rank, (q, k) tail shape, and the mask/bias broadcast
+    patterns (which dims are 1).  The probe shrinks lead dims to 1 —
+    block shapes there are 1 either way, only grid size changes — so a
+    config that lowers for the probe lowers for the real call."""
+    from .backend import kernel_probe_ok
+
+    q, k = (x.shape[-2], x.shape[-1]) if x.ndim >= 2 else (1, x.shape[-1])
+    pat = lambda op: (
+        None if op is None
+        else (op.dtype.name, tuple(s == 1 for s in op.shape))
+    )
+    key = ("softmax_dropout", x.dtype.name, x.ndim, q, k,
+           pat(mask), pat(bias), dropout_on)
+
+    def build():
+        from .pallas import softmax_dropout as pl_impl
+
+        px_shape = (1,) * (x.ndim - 2) + (q, k)
+        px = jnp.zeros(px_shape, x.dtype)
+
+        def shrink(op):
+            if op is None:
+                return None
+            off = len(px_shape) - op.ndim
+            shape = tuple(
+                1 if s == 1 else px_shape[i + off]
+                for i, s in enumerate(op.shape)
+            )
+            return jnp.zeros(shape, op.dtype)
+
+        pm, pb = shrink(mask), shrink(bias)
+        prng = jax.random.PRNGKey(0) if dropout_on else None
+        dp = 0.1 if dropout_on else 0.0
+
+        def f(px):
+            return jnp.sum(
+                pl_impl.softmax_dropout(
+                    px, dp, rng=prng, is_training=dropout_on,
+                    mask=pm, bias=pb,
+                ).astype(jnp.float32)
+            )
+
+        jax.jit(jax.grad(f)).lower(px).compile()
+
+    return kernel_probe_ok(key, build)
 
 
 def _pallas_eligible(x, mask, bias):
