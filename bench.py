@@ -57,6 +57,7 @@ def _build_trainer(cfg):
 
     args = Namespace(
         seed=1, update_freq=[1], clip_norm=1.0, ema_decay=-1.0,
+        stats_lag=1, rng_impl="rbg",
         fp16=False, bf16=True, bf16_sr=False,
         optimizer="adam", lr=[1e-4], adam_betas="(0.9, 0.98)",
         adam_eps=1e-8, weight_decay=0.01,
@@ -116,11 +117,14 @@ def _run(cfg):
     with metrics.aggregate("train"):
         for _ in range(cfg["warmup"]):
             logs = trainer.train_step([batch])
-        # train_step device_gets its stats every step, so timing the host
-        # loop is an honest end-to-end measurement of the framework step
+        trainer.flush_stats()
+        # the timed region includes the final flush_stats (drains the
+        # lagged-stats pipeline), so every dispatched step's device time
+        # AND its host bookkeeping are inside the measurement
         t0 = time.perf_counter()
         for _ in range(cfg["steps"]):
-            logs = trainer.train_step([batch])
+            trainer.train_step([batch])
+        logs = trainer.flush_stats()
         dt = time.perf_counter() - t0
 
     final_loss = float(logs[0]["loss"])
@@ -128,8 +132,149 @@ def _run(cfg):
     return cfg["batch"] * cfg["steps"] / dt, final_loss
 
 
+def _peak_flops():
+    """bf16 peak of the attached chip, or None if unknown."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return None
+
+
+def _train_flops_per_step(cfg):
+    """Model FLOPs per optimizer step (fwd + ~2x bwd), matmuls only."""
+    B, T = cfg["batch"], cfg["seq"]
+    per_layer = 4 * DIM * DIM + 2 * DIM * FFN  # qkv+out, fc1+fc2 (MACs/token)
+    enc = B * T * per_layer * LAYERS
+    attn = LAYERS * B * HEADS * T * T * (DIM // HEADS) * 2  # QK^T + PV
+    k_slots = min(-(-int(round(B * T * 0.25)) // 128) * 128, B * T)
+    head = k_slots * (DIM * DIM + DIM * VOCAB)
+    return 3.0 * 2.0 * (enc + attn + head)  # 2 FLOPs/MAC, 3x for training
+
+
+def _clean(msg, limit=300):
+    """One-line, length-capped error text (the round-2 bench emitted
+    multi-line reprs inside the JSON line and the driver recorded
+    ``parsed: null``)."""
+    return " ".join(str(msg).split())[:limit]
+
+
+def _timed(fn, *args, iters=5):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _microbench():
+    """Pallas-vs-jnp-reference speedups on the chip (the analogue of the
+    reference's fused-vs-eager CUDA kernel comparison, BASELINE.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unicore_tpu import ops
+    from unicore_tpu.ops.backend import kernel_backend
+    from unicore_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    out = {}
+
+    # fused softmax_dropout (bias+mask+softmax), fwd+bwd, BERT shape
+    x = jnp.asarray(rng.randn(32, 12, 512, 512), jnp.bfloat16)
+    bias = jnp.asarray(rng.randn(1, 12, 512, 512), jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+
+    def sd_loss(x, bias):
+        return jnp.sum(
+            ops.softmax_dropout(x, 0.1, rng=key, is_training=True, bias=bias)
+            .astype(jnp.float32)
+        )
+
+    g_sd = jax.jit(jax.grad(sd_loss))
+    with kernel_backend("pallas"):
+        t_p = _timed(g_sd, x, bias)
+    g_sd2 = jax.jit(jax.grad(sd_loss))  # re-trace under the other backend
+    with kernel_backend("reference"):
+        t_r = _timed(g_sd2, x, bias)
+    out["softmax_dropout_speedup"] = round(t_r / t_p, 3)
+
+    # fused LayerNorm fwd+bwd
+    xl = jnp.asarray(rng.randn(32 * 512, 768), jnp.bfloat16)
+    w = jnp.ones((768,), jnp.float32)
+    b = jnp.zeros((768,), jnp.float32)
+
+    def ln_loss(x, w, b):
+        return jnp.sum(ops.layer_norm(x, w, b).astype(jnp.float32))
+
+    g_ln = jax.jit(jax.grad(ln_loss, argnums=(0, 1, 2)))
+    with kernel_backend("pallas"):
+        t_p = _timed(g_ln, xl, w, b)
+    g_ln2 = jax.jit(jax.grad(ln_loss, argnums=(0, 1, 2)))
+    with kernel_backend("reference"):
+        t_r = _timed(g_ln2, xl, w, b)
+    out["layer_norm_speedup"] = round(t_r / t_p, 3)
+
+    # flash vs materialized attention at long context (T=2048, no bias —
+    # the regime the flash tier exists for)
+    q = jnp.asarray(rng.randn(4, 2048, 12, 64), jnp.bfloat16)
+
+    def fl_loss(q):
+        return jnp.sum(
+            flash_attention(q, q, q, is_training=False).astype(jnp.float32)
+        )
+
+    def mat_loss(q):
+        qt = jnp.einsum("bqhd->bhqd", q)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, qt) * (64 ** -0.5)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, qt).astype(jnp.float32))
+
+    t_p = _timed(jax.jit(jax.grad(fl_loss)), q)
+    t_r = _timed(jax.jit(jax.grad(mat_loss)), q)
+    out["flash_attention_t2048_speedup"] = round(t_r / t_p, 3)
+    return out
+
+
+def _e2e_backend_speedup(cfg):
+    """Kernel-tier speedup on the REAL train step: auto (pallas kernels +
+    measured dispatch heuristics) vs the all-jnp reference backend.  This
+    is the honest analogue of the reference's fused-vs-eager CUDA claim —
+    isolated-op micro numbers miss the residual-memory pressure that only
+    shows up in the full model."""
+    from unicore_tpu.ops.backend import kernel_backend
+
+    small = dict(cfg, steps=5, warmup=2)
+    auto_sps, _ = _run(small)
+    with kernel_backend("reference"):
+        ref_sps, _ = _run(small)
+    return round(auto_sps / ref_sps, 3)
+
+
 def main():
     errors = []
+    micro = None
+    if os.environ.get("BENCH_MICRO", "1") == "1":
+        try:
+            micro = _microbench()
+        except Exception as e:  # noqa: BLE001
+            micro = {"error": _clean(e)}
+        try:
+            micro["kernel_tier_e2e_speedup"] = _e2e_backend_speedup(CONFIGS[0])
+        except Exception as e:  # noqa: BLE001
+            micro["kernel_tier_e2e_speedup_error"] = _clean(e)
     for ci, cfg in enumerate(CONFIGS):
         for attempt in range(ATTEMPTS_PER_CONFIG):
             try:
@@ -144,16 +289,27 @@ def main():
                     "config": {k: cfg[k] for k in ("batch", "seq", "steps")},
                     "final_loss": round(final_loss, 4),
                 }
+                peak = _peak_flops()
+                if peak:
+                    step_flops = _train_flops_per_step(cfg)
+                    out["mfu"] = round(
+                        samples_per_sec / cfg["batch"] * step_flops / peak, 4
+                    )
+                if micro:
+                    out["micro"] = micro
                 if ci > 0:
-                    out["error"] = (
-                        f"degraded: primary config failed, measured fallback "
-                        f"#{ci}; attempts: {errors[-3:]}"
+                    out["error"] = _clean(
+                        "degraded: primary config failed, measured fallback "
+                        f"#{ci}; attempts: {errors[-3:]}", 600,
                     )
                 print(json.dumps(out))
                 return 0
             except Exception as e:
                 tb = traceback.format_exc(limit=3)
-                errors.append(f"cfg{ci} attempt{attempt}: {e!r}")
+                errors.append(
+                    f"cfg{ci} attempt{attempt}: "
+                    f"{type(e).__name__}: {_clean(e)}"
+                )
                 sys.stderr.write(tb + "\n")
                 time.sleep(5 * (attempt + 1))
     print(json.dumps({
@@ -161,7 +317,7 @@ def main():
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
-        "error": "; ".join(errors[-6:]),
+        "error": _clean("; ".join(errors[-6:]), 900),
     }))
     return 0
 

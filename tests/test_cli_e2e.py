@@ -6,6 +6,7 @@ and CPU-runnable (SURVEY §4)."""
 
 import os
 import pickle
+import re
 import subprocess
 import sys
 
@@ -64,6 +65,14 @@ def test_cli_train_and_resume(corpus, tmp_path):
     assert "done training" in r.stdout
     assert os.path.exists(os.path.join(save_dir, "checkpoint_last.pt"))
     assert os.path.exists(os.path.join(save_dir, "checkpoint_1_5.pt"))
+
+    # lagged-stats regression: each update count validates at most once
+    # (the stale processed count used to re-fire save/validate on the
+    # step after every interval boundary)
+    val_steps = re.findall(
+        r"valid on 'valid' subset.*?num_updates (\d+)", r.stdout
+    )
+    assert len(val_steps) == len(set(val_steps)), val_steps
 
     # checkpoint payload is a torch-free pickled numpy pytree
     with open(os.path.join(save_dir, "checkpoint_last.pt"), "rb") as f:

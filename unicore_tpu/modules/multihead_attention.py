@@ -53,6 +53,19 @@ def _flash_ok(q, k, bias, has_pad, dropout_on):
     ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
     if not fa.eligible(qs, ks, None if bias is None else bias.shape):
         return False
+    # measured on v5e (BERT-base, T=512): with a TRAINABLE bias the flash
+    # backward pays an extra full dbias recompute pass and loses to the
+    # materialized einsum + fused-softmax path (~108 vs ~98 samples/s);
+    # flash wins once [B,H,Tq,Tk] is HBM-prohibitive.  Auto mode picks by
+    # sequence length; a forced "pallas" backend still takes flash.
+    from unicore_tpu.ops.backend import get_kernel_backend
+
+    if (
+        get_kernel_backend() != "pallas"
+        and bias is not None
+        and k.shape[1] < 1024
+    ):
+        return False
     # fail-open: compile-probe THIS config once per process (dtype/seq
     # lens/bias kind change the BlockSpecs); if it doesn't lower on this
     # backend, use the materialized path instead of crashing training

@@ -100,6 +100,13 @@ class Trainer:
         else:
             parallel.disable_sequence_parallel()
 
+        rng_impl = getattr(args, "rng_impl", None)
+        if rng_impl:
+            # rbg cuts ~21ms/step off BERT-base on v5e (threefry random
+            # bits dominate the ~25 dropout sites); global jax config, set
+            # before any step traces
+            jax.config.update("jax_default_prng_impl", rng_impl)
+
         self.update_freq = (
             args.update_freq[0]
             if isinstance(getattr(args, "update_freq", 1), (list, tuple))
@@ -120,6 +127,13 @@ class Trainer:
         self._jit_train_step = None
         self._jit_valid_step = None
         self.total_train_steps = None
+        # pipelined stats: keep up to ``stats_lag`` steps' device stats
+        # un-fetched so dispatch N+1 overlaps the device_get/bookkeeping of
+        # step N (on a remote/relayed chip the per-step blocking fetch was
+        # costing ~40% of wall time); 0 restores strict per-step sync
+        self.stats_lag = max(0, int(getattr(args, "stats_lag", 0) or 0))
+        self._pending_stats: List[Any] = []
+        self._dispatch_count: Optional[int] = None
 
         self._logging_proto_cached = None
         self._start_time = time.time()
@@ -312,11 +326,25 @@ class Trainer:
                 lambda _: jnp.zeros((), jnp.float32), self._logging_proto
             )
             n_micro = weights.shape[0]
-            (grads, sample_size, summed_logs), stacked_logs = jax.lax.scan(
-                micro,
-                (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
-                (batches, weights, jnp.arange(n_micro)),
-            )
+            if n_micro == 1:
+                # no grad accumulation: skip the scan so XLA fuses the
+                # backward straight into clip/update (a 1-iteration scan
+                # still materializes the carry grad tree)
+                one = jax.tree_util.tree_map(lambda x: x[0], batches)
+                (grads, sample_size, summed_logs), ys = micro(
+                    (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
+                    (one, weights[0], jnp.int32(0)),
+                )
+                stacked_logs = (
+                    None if ys is None
+                    else jax.tree_util.tree_map(lambda y: y[None], ys)
+                )
+            else:
+                (grads, sample_size, summed_logs), stacked_logs = jax.lax.scan(
+                    micro,
+                    (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
+                    (batches, weights, jnp.arange(n_micro)),
+                )
             logs = summed_logs if sum_logs else stacked_logs
 
             # unscale + normalize by the GLOBAL sample size in one multiply
@@ -345,7 +373,12 @@ class Trainer:
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p + u, state["params"], updates
             )
-            # overflow-skip as a state bypass (reference trainer.py:755-761)
+            # overflow-skip as a state bypass (reference trainer.py:755-761).
+            # Applied on every path — including the no-scaler one, where the
+            # host aborts on the overflow stat: with lagged stats one more
+            # step is dispatched before the abort, and without the select it
+            # would compound NaN moments into the params, blinding the
+            # NaN-detector re-run (select cost measured within noise on v5e).
             keep = lambda new, old: jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new, old
             )
@@ -409,7 +442,13 @@ class Trainer:
 
     @metrics.aggregate("train")
     def train_step(self, samples: List[Dict[str, Any]]):
-        """One update: grad accumulation over ``samples`` micro-batches."""
+        """One update: grad accumulation over ``samples`` micro-batches.
+
+        With ``stats_lag > 0`` the returned logging outputs are those of
+        the step dispatched ``stats_lag`` calls ago (None while the
+        pipeline fills); callers that need exact counts/meters (stop
+        checks, checkpoint, validation) call :meth:`flush_stats` first.
+        """
         self._set_seed_noop()
         if self.state is None:
             self.init_state(samples[0])
@@ -419,15 +458,51 @@ class Trainer:
             self._jit_train_step = self._make_train_step()
             self._logging_proto_cached = None
 
-        lr = jnp.float32(self.get_lr())
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.seed), self.get_num_updates()
+        if self._dispatch_count is None:
+            self._dispatch_count = self.get_num_updates()
+        # dispatch-time LR from the OPTIMISTIC update count: with lagged
+        # stats the processed count is stale by up to stats_lag, and the
+        # sync semantics are "update N runs at the LR set after update
+        # N-1" — step_update is a pure function of the count for every
+        # scheduler, so re-invoking it here is side-effect-safe (the
+        # metrics lr gauge is still logged at processing time)
+        lr = jnp.float32(
+            self.lr_scheduler.step_update(
+                self.get_num_updates() + len(self._pending_stats)
+            )
         )
+        # fold by the DISPATCH counter, not num_updates: with lagged stats
+        # the update count is stale at dispatch time, and two steps must
+        # never draw the same dropout stream (the reference's per-update
+        # torch_seed scoping, trainer.py:610-616)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), self._dispatch_count
+        )
+        self._dispatch_count += 1
         self.state, stats = self._jit_train_step(
             self.state, batches, jnp.asarray(weights_np), lr, rng
         )
 
-        # host-side bookkeeping (one device->host sync per step for stats)
+        self._pending_stats.append((stats, weights_np, samples[0]))
+        out = None
+        while len(self._pending_stats) > self.stats_lag:
+            out = self._process_stats(*self._pending_stats.pop(0))
+        return out
+
+    def flush_stats(self):
+        """Drain pending lagged stats so num_updates/meters are exact."""
+        out = None
+        while self._pending_stats:
+            out = self._process_stats(*self._pending_stats.pop(0))
+        return out
+
+    def num_pending_updates(self):
+        """Dispatched-but-unprocessed steps (optimistic update count =
+        ``get_num_updates() + num_pending_updates()``)."""
+        return len(self._pending_stats)
+
+    def _process_stats(self, stats, weights_np, first_sample):
+        # host-side bookkeeping (one device->host sync per processed step)
         stats = jax.device_get(stats)
         overflow = bool(stats["overflow"] > 0)
         if overflow:
@@ -440,7 +515,7 @@ class Trainer:
                 try:
                     log_nonfinite_modules(
                         self.model, self.state["params"],
-                        self._prepare_sample_host(samples[0]),
+                        self._prepare_sample_host(first_sample),
                     )
                 except Exception as e:  # detector must never mask the abort
                     logger.warning("NanDetector re-run failed: %s", e)
@@ -474,6 +549,7 @@ class Trainer:
         return logging_outputs
 
     def valid_step(self, sample):
+        self.flush_stats()  # exact meters/num_updates before eval
         if self.state is None:
             self.init_state(sample)
         if self._jit_valid_step is None:
@@ -635,6 +711,7 @@ class Trainer:
 
     def begin_epoch(self, epoch):
         """Called at the beginning of each epoch (trainer.py:565-571)."""
+        self.flush_stats()
         logger.info("begin training epoch {}".format(epoch))
         self.lr_step_begin_epoch(epoch)
         self.task.begin_epoch(epoch, self.model)
@@ -745,6 +822,7 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def state_dict(self):
+        self.flush_stats()  # checkpoints must carry exact counts/meters
         state_np = (
             utils.tree_map_arrays(np.asarray, jax.device_get(self.state))
             if self.state is not None

@@ -56,6 +56,11 @@ class TrainLoop:
         """max-update / wall-clock limits, checked after every step."""
         updates = self.trainer.get_num_updates()
         max_update = self.args.max_update or math.inf
+        # lagged-stats pipeline: only pay a flush when the optimistic
+        # (dispatched) count could hit the limit, then re-check exactly
+        if updates + self.trainer.num_pending_updates() >= max_update:
+            self.trainer.flush_stats()
+            updates = self.trainer.get_num_updates()
         if updates >= max_update:
             logger.info(
                 "stopping: num_updates %d >= --max-update %s",
@@ -167,20 +172,42 @@ class TrainLoop:
 
     def validate_and_save(self, epoch_itr, end_of_epoch):
         args = self.args
+        # lagged-stats pipeline: flush when this round could owe an action
+        # (interval conditions are evaluated on the exact processed count;
+        # checkpoints/validation need exact meters) — in the common
+        # no-action step this stays flush-free so dispatch keeps pipelining
+        opt_updates = (
+            self.trainer.get_num_updates() + self.trainer.num_pending_updates()
+        )
+        may_act = end_of_epoch or (
+            args.save_interval_updates > 0
+            and opt_updates > 0
+            and opt_updates % args.save_interval_updates == 0
+        ) or (
+            args.validate_interval_updates > 0
+            and opt_updates > 0
+            and opt_updates % args.validate_interval_updates == 0
+        )
+        if may_act:
+            self.trainer.flush_stats()
+            opt_updates = self.trainer.get_num_updates()
         updates = self.trainer.get_num_updates()
         stop = self._hit_hard_limits()
 
         # what this round owes: a checkpoint, a validation pass, both, or
         # neither (reference validate_and_save condition trees,
-        # unicore_cli/train.py:247-320)
+        # unicore_cli/train.py:247-320).  Interval conditions test the
+        # OPTIMISTIC count: the processed count is stale by stats_lag, so
+        # testing it would re-fire the condition on the step after each
+        # boundary (duplicate checkpoint + validation)
         save_now = stop or (
             end_of_epoch
             and epoch_itr.epoch % args.save_interval == 0
             and not args.no_epoch_checkpoints
         ) or (
             args.save_interval_updates > 0
-            and updates > 0
-            and updates % args.save_interval_updates == 0
+            and opt_updates > 0
+            and opt_updates % args.save_interval_updates == 0
             and updates >= args.validate_after_updates
         )
         validate_now = not args.disable_validation and (
@@ -193,8 +220,8 @@ class TrainLoop:
             )
             or (
                 args.validate_interval_updates > 0
-                and updates > 0
-                and updates % args.validate_interval_updates == 0
+                and opt_updates > 0
+                and opt_updates % args.validate_interval_updates == 0
             )
         )
 
