@@ -291,9 +291,15 @@ def main():
                 }
                 peak = _peak_flops()
                 if peak:
+                    import jax
+
+                    # per-chip MFU: throughput is global (whole mesh), so
+                    # normalize by device count before dividing by one
+                    # chip's peak
                     step_flops = _train_flops_per_step(cfg)
                     out["mfu"] = round(
-                        samples_per_sec / cfg["batch"] * step_flops / peak, 4
+                        samples_per_sec / cfg["batch"] * step_flops
+                        / jax.device_count() / peak, 4,
                     )
                 if micro:
                     out["micro"] = micro

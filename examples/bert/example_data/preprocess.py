@@ -1,0 +1,83 @@
+"""Build a BERT MLM corpus for ``unicore-train`` from plain text.
+
+The analogue of the reference's
+``examples/bert/example_data/preprocess.py`` (text file -> LMDB of raw
+lines), TPU-stack form: text file(s) -> native ``.rec`` record stores
+(``IndexedRecordWriter`` — no lmdb dependency) plus a whitespace
+``dict.txt`` harvested from the training split, so the quickstart needs
+no external tokenizer.
+
+Usage:
+    python preprocess.py TRAIN_TXT [VALID_TXT] [-o OUT_DIR]
+                         [--max-vocab N] [--no-dict]
+
+- one record per non-empty line, stored as the list of whitespace tokens
+  (train with ``--pre-tokenized``);
+- ``dict.txt`` lists ``<symbol> <count>`` by descending frequency (the
+  format ``Dictionary.load`` reads); pass ``--no-dict`` to keep an
+  existing WordPiece vocab and store raw lines instead.
+"""
+
+import argparse
+import collections
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))),
+)
+
+from unicore_tpu.data import IndexedRecordWriter  # noqa: E402
+
+
+def convert(txt_path, rec_path, tokenize, counter=None):
+    n = 0
+    with open(txt_path, "r", encoding="utf-8") as src, \
+            IndexedRecordWriter(rec_path) as out:
+        for line in src:
+            toks = line.strip().split()
+            if not toks:
+                continue
+            if counter is not None:
+                counter.update(toks)
+            out.write(toks if tokenize else line.strip())
+            n += 1
+    print(f"{txt_path}: {n} records -> {rec_path}")
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("train", help="training text file (one sample per line)")
+    p.add_argument("valid", nargs="?", help="validation text file")
+    p.add_argument("-o", "--out-dir", default=".",
+                   help="output directory (default: cwd)")
+    p.add_argument("--max-vocab", type=int, default=30000,
+                   help="keep the N most frequent tokens")
+    p.add_argument("--no-dict", action="store_true",
+                   help="store raw lines (for an external WordPiece vocab) "
+                        "instead of whitespace tokens + dict.txt")
+    args = p.parse_args()
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    counter = None if args.no_dict else collections.Counter()
+    convert(args.train, os.path.join(args.out_dir, "train.rec"),
+            tokenize=not args.no_dict, counter=counter)
+    if args.valid:
+        convert(args.valid, os.path.join(args.out_dir, "valid.rec"),
+                tokenize=not args.no_dict)
+
+    if counter is not None:
+        dict_path = os.path.join(args.out_dir, "dict.txt")
+        with open(dict_path, "w", encoding="utf-8") as f:
+            for sym, cnt in counter.most_common(args.max_vocab):
+                f.write(f"{sym} {cnt}\n")
+        print(f"dict.txt: {min(len(counter), args.max_vocab)} types "
+              f"-> {dict_path}")
+
+
+if __name__ == "__main__":
+    main()

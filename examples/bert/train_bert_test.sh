@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Smoke-train the BERT example on one chip (or CPU with --cpu appended).
+# The analogue of the reference's examples/bert/train_bert_test.sh — no
+# torch.distributed.launch: one process drives all local devices under
+# SPMD, and multi-host runs add --coordinator-address/--num-processes.
+#
+#   1. python example_data/preprocess.py train.txt valid.txt -o ./example_data
+#   2. bash train_bert_test.sh [extra unicore-train args...]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+DATA_DIR=${DATA_DIR:-./example_data}
+SAVE_DIR=${SAVE_DIR:-./save}
+
+python -m unicore_tpu_cli.train "$DATA_DIR" --user-dir . --valid-subset valid \
+    --num-workers 0 \
+    --task bert --loss masked_lm --arch bert_base --pre-tokenized \
+    --optimizer adam --adam-betas '(0.9, 0.98)' --adam-eps 1e-6 --clip-norm 1.0 \
+    --lr-scheduler polynomial_decay --lr 1e-4 --warmup-updates 100 \
+    --total-num-update 10000 --batch-size 4 \
+    --update-freq 1 --seed 1 \
+    --bf16 --tensorboard-logdir ./tsb/ \
+    --max-update 10000 --log-interval 100 --log-format simple \
+    --save-interval-updates 5000 --validate-interval-updates 5000 \
+    --keep-interval-updates 30 --no-epoch-checkpoints \
+    --save-dir "$SAVE_DIR" "$@"

@@ -1,0 +1,183 @@
+"""True multi-process coverage (VERDICT r2 item 7): two OS processes run
+``jax.distributed.initialize`` over a local TCP coordinator on the CPU
+backend (2 virtual devices each -> a 4-device global mesh) and exercise
+the ``process_count() > 1`` branches that single-process tests never
+reach:
+
+- ``distributed.all_gather_objects`` (pickle allgather, ordered);
+- the ragged-tail micro-batch weight reconcile in
+  ``Trainer._stack_microbatches`` (slot weights min-reduced across hosts);
+- ``jax.make_array_from_process_local_data`` global-batch assembly in
+  ``Trainer._to_device``.
+
+Run as a worker: ``python tests/test_multiprocess.py <pid> <port>``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(pid, port):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("UNICORE_TPU_TEST_ON_TPU", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), str(pid), str(port)],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_two_process_trainer_and_collectives():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = [_spawn(i, port) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multi-process workers timed out:\n" +
+                    "\n".join(o or "" for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
+        assert "WORKER_OK" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+
+# ---------------------------------------------------------------------------
+# worker body
+# ---------------------------------------------------------------------------
+
+
+def _worker(pid, port):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4
+
+    import logging
+    from argparse import Namespace
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from unicore_tpu import metrics
+    from unicore_tpu.distributed import utils as dist_utils
+    from unicore_tpu.losses.unicore_loss import UnicoreLoss
+    from unicore_tpu.models.unicore_model import BaseUnicoreModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    # -- all_gather_objects: ordered, arbitrary payloads ---------------
+    got = dist_utils.all_gather_objects({"rank": pid, "tag": "x" * (pid + 1)})
+    assert [g["rank"] for g in got] == [0, 1], got
+    assert got[1]["tag"] == "xx"
+
+    # -- trainer over the 2-process mesh --------------------------------
+    VOCAB, DIM = 13, 16
+
+    class ToyModel(BaseUnicoreModel):
+        @nn.compact
+        def __call__(self, src_tokens, deterministic=True, **kw):
+            x = nn.Embed(VOCAB, DIM, name="embed")(src_tokens)
+            return nn.Dense(VOCAB, name="out")(x)
+
+    class ToyLoss(UnicoreLoss):
+        def forward(self, model, params, sample, rng=None, is_training=True):
+            logits = model.apply(
+                {"params": params}, **sample["net_input"],
+                deterministic=not is_training,
+            )
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            t = sample["target"]
+            nll = -jnp.take_along_axis(lp, t[..., None], axis=-1)[..., 0]
+            n = jnp.asarray(np.prod(t.shape), dtype=jnp.float32)
+            return jnp.sum(nll), n, {"loss": jnp.sum(nll), "sample_size": n,
+                                     "bsz": jnp.float32(t.shape[0])}
+
+        @staticmethod
+        def reduce_metrics(logging_outputs, split="train"):
+            n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+            loss = sum(float(l.get("loss", 0)) for l in logging_outputs)
+            metrics.log_scalar("loss", loss / max(n, 1), n, round=3)
+
+        @staticmethod
+        def logging_outputs_can_be_summed(is_train):
+            return True
+
+    class ToyTask(UnicoreTask):
+        pass
+
+    args = Namespace(
+        seed=1, update_freq=[2], clip_norm=0.0, ema_decay=-1.0,
+        fp16=False, bf16=False, bf16_sr=False, stats_lag=0,
+        optimizer="adam", lr=[1e-2], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=10, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+    task = ToyTask(args)
+    trainer = Trainer(args, task, ToyModel(), ToyLoss(task))
+
+    def local_batch(seed):
+        rng = np.random.RandomState(seed)
+        # per-host LOCAL shard: 4 rows here, 8 global
+        toks = rng.randint(0, VOCAB, size=(4, 8)).astype(np.int64)
+        return {"net_input": {"src_tokens": toks}, "target": toks.copy()}
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    trainer_logger = logging.getLogger("unicore_tpu.trainer")
+    trainer_logger.addHandler(handler)
+    trainer_logger.setLevel(logging.INFO)
+
+    metrics.reset()
+    with metrics.aggregate("train"):
+        # step 1: both hosts real in both slots
+        logs = trainer.train_step([local_batch(0), local_batch(1)])
+        assert float(logs[0]["sample_size"]) == 2 * 8 * 8  # 2 slots x global
+        # step 2, ragged tail: host 1's second slot is empty -> the slot is
+        # min-reconciled to weight 0 on BOTH hosts
+        second = [local_batch(2), local_batch(3) if pid == 0 else None]
+        logs = trainer.train_step(second)
+        assert float(logs[0]["sample_size"]) == 8 * 8, logs
+
+    assert trainer.get_num_updates() == 2
+    if pid == 0:
+        assert any("ragged-tail" in m for m in records), records
+
+    # params stay replicated and identical across hosts
+    leaf = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(trainer.state["params"])[0])
+    )
+    digests = dist_utils.all_gather_objects(float(np.sum(leaf)))
+    assert np.allclose(digests[0], digests[1]), digests
+
+    print("WORKER_OK", pid)
+
+
+if __name__ == "__main__":
+    _worker(int(sys.argv[1]), int(sys.argv[2]))

@@ -128,6 +128,43 @@ def _sched(name, opt_args=None, total=None, **kw):
     return LR_SCHEDULER_REGISTRY[name](args, opt, total)
 
 
+def test_schedules_jit_compatible():
+    """The pure schedule functions trace under jit (branchless via where)
+    and agree with their host-float values — the property that lets a
+    training setup fold LR computation into the compiled step."""
+    from unicore_tpu.optim.lr_scheduler import schedules
+
+    f = jax.jit(lambda s: schedules.polynomial_decay(
+        s, base_lr=1e-4, end_lr=0.0, power=1.0, warmup_updates=10,
+        total_updates=110))
+    np.testing.assert_allclose(float(f(jnp.int32(5))), 1e-4 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.int32(60))), 1e-4 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.int32(110))), 0.0, atol=1e-12)
+
+    g = jax.jit(lambda s: schedules.cosine(
+        s.astype(jnp.float32), max_lr=1.0, min_lr=0.0, period=100, t_mult=1,
+        shrink=1.0, warmup_updates=0, warmup_init_lr=0.0))
+    np.testing.assert_allclose(float(g(jnp.int32(50))), 0.5, atol=1e-6)
+
+    h = jax.jit(lambda s: schedules.triangular(
+        s.astype(jnp.float32), min_lr=0.1, max_lr=1.0, stepsize=50,
+        shrink=1.0, shrink_min=False))
+    np.testing.assert_allclose(float(h(jnp.int32(50))), 1.0, rtol=1e-6)
+
+
+def test_cosine_tmult_warmup_no_domain_error():
+    """t_mult != 1 with warmup longer than period/(t_mult-1): the annealing
+    branch is evaluated unconditionally, so negative cycle time must be
+    clamped before the log (regression: math domain error at step 0)."""
+    from unicore_tpu.optim.lr_scheduler import schedules
+
+    kw = dict(max_lr=1.0, min_lr=0.0, period=5000, t_mult=2, shrink=1.0,
+              warmup_updates=10000, warmup_init_lr=0.0)
+    np.testing.assert_allclose(schedules.cosine(0, **kw), 0.0, atol=1e-12)
+    np.testing.assert_allclose(schedules.cosine(5000, **kw), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(schedules.cosine(10000, **kw), 1.0, rtol=1e-6)
+
+
 def test_scheduler_registry_contents():
     for name in (
         "fixed", "cosine", "inverse_sqrt", "polynomial_decay",

@@ -117,3 +117,33 @@ def test_ulysses_key_padding_mask_headdim1_bias(rng, mesh, qkv):
         mesh, q, k, v, key_padding_mask=jnp.asarray(pad)
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_seq_parallel_attention_dropout_fails_fast(rng, mesh, qkv):
+    """attention_dropout > 0 under sequence parallelism is an error unless
+    the dropout skip is explicitly accepted (advisor r2: silent
+    regularization loss must not scroll by as a one-line warning)."""
+    from unicore_tpu import parallel
+    from unicore_tpu.modules import multihead_attention as mha
+
+    q, k, v = qkv
+    devs = jax.devices()
+    mesh = jax.sharding.Mesh(
+        np.asarray(devs[:8]).reshape(1, 1, 8), ("data", "fsdp", "seq")
+    )
+    parallel.enable_sequence_parallel(mesh, "ring")
+    try:
+        with pytest.raises(ValueError, match="attention_dropout"):
+            mha._seq_parallel_attend(
+                q, k, v, scaling=0.25, dropout=0.1,
+                key_padding_mask=None, bias=None,
+            )
+        # explicit opt-in: no raise, dropout skipped
+        parallel.enable_sequence_parallel(mesh, "ring", allow_dropout_skip=True)
+        out = mha._seq_parallel_attend(
+            q, k, v, scaling=0.25, dropout=0.1,
+            key_padding_mask=None, bias=None,
+        )
+        assert out is not None
+    finally:
+        parallel.disable_sequence_parallel()

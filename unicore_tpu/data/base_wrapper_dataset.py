@@ -1,7 +1,23 @@
-"""Base for datasets that wrap another dataset
-(reference: unicore/data/base_wrapper_dataset.py)."""
+"""Transparent wrapper base (fills the role of
+``unicore/data/base_wrapper_dataset.py``).
+
+Instead of hand-writing one forwarding method per protocol member, the
+delegating methods are generated from the protocol surface below —
+subclasses override just the members they change, and any protocol
+addition only needs its name added to one tuple.
+"""
 
 from .unicore_dataset import UnicoreDataset
+
+
+def _forward(name):
+    def method(self, *args, **kwargs):
+        return getattr(self.dataset, name)(*args, **kwargs)
+
+    method.__name__ = name
+    method.__qualname__ = f"BaseWrapperDataset.{name}"
+    method.__doc__ = f"Forward ``{name}`` to the wrapped dataset."
+    return method
 
 
 class BaseWrapperDataset(UnicoreDataset):
@@ -15,33 +31,20 @@ class BaseWrapperDataset(UnicoreDataset):
     def __len__(self):
         return len(self.dataset)
 
-    def collater(self, samples):
-        return self.dataset.collater(samples)
-
-    def num_tokens(self, index):
-        return self.dataset.num_tokens(index)
-
-    def size(self, index):
-        return self.dataset.size(index)
-
-    def ordered_indices(self):
-        return self.dataset.ordered_indices()
+    def set_epoch(self, epoch):
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
 
     @property
     def supports_prefetch(self):
         return getattr(self.dataset, "supports_prefetch", False)
 
-    def attr(self, attr: str, index: int):
-        return self.dataset.attr(attr, index)
-
-    def prefetch(self, indices):
-        self.dataset.prefetch(indices)
-
     @property
     def can_reuse_epoch_itr_across_epochs(self):
         return self.dataset.can_reuse_epoch_itr_across_epochs
 
-    def set_epoch(self, epoch):
-        super().set_epoch(epoch)
-        if hasattr(self.dataset, "set_epoch"):
-            self.dataset.set_epoch(epoch)
+
+for _name in ("collater", "num_tokens", "size", "ordered_indices",
+              "prefetch", "attr"):
+    setattr(BaseWrapperDataset, _name, _forward(_name))
+del _name

@@ -79,14 +79,16 @@ class Dictionary:
 
         Uses a sorted-symbol ``np.searchsorted`` table (rebuilt only when
         the vocab changes) — O(len(a) * log V) in numpy instead of one
-        Python dict probe per element.
+        Python dict probe per element.  Built from ``_sym2id`` (the
+        authoritative map): after ``add_symbol(.., overwrite=True)`` the
+        old row lingers in ``_id2sym``, and a table built from it could
+        resolve the symbol to its stale id.
         """
         if self._vec_cache is None:
-            order = np.argsort(np.asarray(self._id2sym))
-            self._vec_cache = (
-                np.asarray(self._id2sym)[order],  # sorted symbols
-                order.astype(np.int64),  # their ids
-            )
+            syms = np.asarray(list(self._sym2id.keys()))
+            ids = np.asarray(list(self._sym2id.values()), dtype=np.int64)
+            order = np.argsort(syms)
+            self._vec_cache = (syms[order], ids[order])
         sorted_syms, ids = self._vec_cache
         a = np.asarray(a)
         pos = np.searchsorted(sorted_syms, a)

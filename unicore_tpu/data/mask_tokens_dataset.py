@@ -94,13 +94,18 @@ class _MaskPlan(BaseWrapperDataset):
 
     @lru_cache(maxsize=16)
     def _plan(self, epoch, index):
-        item = np.asarray(self.dataset[index])
-        if self.mask_idx in item:
-            raise ValueError(
-                f"sample {index} already contains mask_idx={self.mask_idx}"
-            )
-        n = len(item)
         with data_utils.numpy_seed(self.seed, epoch, index):
+            # the fetch happens INSIDE the seeded scope: underlying
+            # datasets that draw numpy randomness (e.g. conformer sampling
+            # in Uni-Mol-style workloads) must stay deterministic per
+            # (seed, epoch, index) — reference mask_tokens_dataset.py
+            # scopes the access the same way
+            item = np.asarray(self.dataset[index])
+            if self.mask_idx in item:
+                raise ValueError(
+                    f"sample {index} already contains mask_idx={self.mask_idx}"
+                )
+            n = len(item)
             # mask-count rounding is probabilistic so E[count] is exact
             count = int(self.mask_prob * n + np.random.rand())
             chosen = np.zeros(n, dtype=bool)

@@ -1,43 +1,44 @@
-"""Ordering wrappers (reference: unicore/data/sort_dataset.py)."""
+"""Ordering wrappers (fill the role of ``unicore/data/sort_dataset.py``).
+
+``SortDataset`` imposes a lexicographic order over one or more key arrays
+(last key is primary, numpy ``lexsort`` convention); ``EpochShuffleDataset``
+draws a fresh deterministic permutation per epoch from a counter-based
+Philox generator seeded by (seed, epoch) — no global numpy RNG state is
+touched, unlike the reference's ``numpy_seed`` context."""
 
 import numpy as np
 
-from . import data_utils
 from .base_wrapper_dataset import BaseWrapperDataset
 
 
 class SortDataset(BaseWrapperDataset):
-    """Order indices by lexicographic sort over *sort_order* keys."""
-
     def __init__(self, dataset, sort_order):
         super().__init__(dataset)
-        if not isinstance(sort_order, (list, tuple)):
-            sort_order = [sort_order]
-        self.sort_order = sort_order
-        assert all(len(so) == len(dataset) for so in sort_order)
+        keys = sort_order if isinstance(sort_order, (list, tuple)) else [sort_order]
+        self._keys = tuple(np.asarray(k) for k in keys)
+        for k in self._keys:
+            if len(k) != len(dataset):
+                raise ValueError(
+                    f"sort key length {len(k)} != dataset length {len(dataset)}"
+                )
 
     def ordered_indices(self):
-        return np.lexsort(self.sort_order)
+        return np.lexsort(self._keys)
 
 
 class EpochShuffleDataset(BaseWrapperDataset):
-    """Shuffle ordering with a fresh per-epoch permutation under
-    numpy_seed(seed + epoch - 1)."""
-
     def __init__(self, dataset, size=None, seed=1):
         super().__init__(dataset)
-        self.size = size if size is not None else len(dataset)
-        self.seed = seed
+        self._n = len(dataset) if size is None else size
+        self._seed = seed
         self.set_epoch(1)
 
     def set_epoch(self, epoch):
         super().set_epoch(epoch)
-        with data_utils.numpy_seed(self.seed + epoch - 1):
-            self.sort_order = np.random.permutation(self.size)
+        gen = np.random.Generator(np.random.Philox(key=self._seed + epoch - 1))
+        self._order = gen.permutation(self._n)
 
     def ordered_indices(self):
-        return self.sort_order
+        return self._order
 
-    @property
-    def can_reuse_epoch_itr_across_epochs(self):
-        return False
+    can_reuse_epoch_itr_across_epochs = False

@@ -1,31 +1,36 @@
-"""Dataset base class (reference: unicore/data/unicore_dataset.py:35-91).
+"""Dataset protocol (fills the role of ``unicore/data/unicore_dataset.py``).
 
-Torch-free: a dataset is a map-style container of numpy-backed samples with a
-``collater`` that builds the padded batch dict the jitted step consumes.
+Torch-free and numpy-first: a dataset is a map-style container whose
+``collater`` builds the padded, static-shape batch dict the jitted step
+consumes.  The protocol is deliberately small — everything the iterator
+stack and tasks rely on:
+
+    __getitem__ / __len__ / collater           (required)
+    num_tokens / size                          (length-based ordering)
+    ordered_indices / batch_by_size            (epoch batch construction)
+    set_epoch / can_reuse_epoch_itr_across_epochs  (epoch listening)
+    supports_prefetch / prefetch / attr        (optional accelerators)
 """
 
 import numpy as np
 
 
 class EpochListening:
-    """Mixin for receiving updates whenever the epoch increments."""
+    """Epoch-awareness half of the protocol: anything that wants the epoch
+    number (per-epoch masking, shuffling, curriculum) implements
+    ``set_epoch``; iterators check ``can_reuse_epoch_itr_across_epochs``
+    before caching a batch order across epochs."""
 
-    @property
-    def can_reuse_epoch_itr_across_epochs(self):
-        """Whether the EpochBatchIterator can be cached across epochs.
-
-        Only safe when the dataset is immune to ``set_epoch`` (no epoch-
-        dependent masking/shuffling below it).
-        """
-        return False
+    can_reuse_epoch_itr_across_epochs = False
 
     def set_epoch(self, epoch):
-        """Will receive the updated epoch number at the beginning of the epoch."""
         pass
 
 
 class UnicoreDataset(EpochListening):
-    """A dataset that provides helpers for batching."""
+    """Map-style dataset with batching helpers."""
+
+    # -- required surface ------------------------------------------------
 
     def __getitem__(self, index):
         raise NotImplementedError
@@ -34,59 +39,39 @@ class UnicoreDataset(EpochListening):
         raise NotImplementedError
 
     def collater(self, samples):
-        """Merge a list of samples to form a mini-batch.
-
-        Args:
-            samples (List[dict]): samples to collate
-
-        Returns:
-            dict: a mini-batch suitable for the jitted step
-        """
+        """Merge a list of samples into the mini-batch dict fed to the
+        jitted step."""
         raise NotImplementedError
 
-    def num_tokens(self, index: int) -> int:
-        """Number of tokens in a sample (used for length-based ordering)."""
+    # -- sizing (length-based ordering / filtering) -----------------------
+
+    def num_tokens(self, index):
         raise NotImplementedError
 
-    def size(self, index: int):
-        """Size of a sample (used for filtering / bucketing)."""
+    def size(self, index):
         raise NotImplementedError
+
+    # -- epoch batch construction -----------------------------------------
 
     def ordered_indices(self):
-        """Ordered list of indices; batches are drawn in this order."""
+        """Index order batches are drawn in (identity by default)."""
         return np.arange(len(self), dtype=np.int64)
 
-    @property
-    def supports_prefetch(self):
-        """Whether this dataset supports prefetching."""
-        return False
-
-    def attr(self, attr: str, index: int):
-        return getattr(self, attr, None)
-
-    def prefetch(self, indices):
-        """Prefetch the data required for this epoch."""
-        raise NotImplementedError
-
-    def batch_by_size(
-        self,
-        indices,
-        batch_size=None,
-        required_batch_size_multiple=1,
-    ):
-        """Chunk the ordered indices into fixed-size batches
-        (reference unicore_dataset.py:67 -> data_utils.batch_by_size)."""
+    def batch_by_size(self, indices, batch_size=None,
+                      required_batch_size_multiple=1):
+        """Chunk ordered indices into fixed-size batches (delegates to
+        ``data_utils.batch_by_size`` — fixed batch size, rounded to the
+        multiple TPU static shapes want)."""
         from unicore_tpu.data import data_utils
 
         return data_utils.batch_by_size(
-            indices,
-            batch_size=batch_size,
+            indices, batch_size=batch_size,
             required_batch_size_multiple=required_batch_size_multiple,
         )
 
     def filter_indices_by_size(self, indices, max_sizes):
-        """Filter a list of sample indices. Remove those that are longer than
-        specified in *max_sizes*. Returns (kept_indices, ignored_indices)."""
+        """Drop indices whose ``size`` exceeds ``max_sizes`` (scalar or
+        per-dimension); returns (kept, ignored_list)."""
         if max_sizes is None:
             return indices, []
         sizes = np.array([self.size(i) for i in indices])
@@ -94,5 +79,15 @@ class UnicoreDataset(EpochListening):
             keep = sizes <= max_sizes
         else:
             keep = np.all(sizes <= np.asarray(max_sizes), axis=-1)
-        ignored = indices[~keep]
-        return indices[keep], ignored.tolist()
+        return indices[keep], indices[~keep].tolist()
+
+    # -- optional accelerators ---------------------------------------------
+
+    supports_prefetch = False
+
+    def prefetch(self, indices):
+        raise NotImplementedError
+
+    def attr(self, attr, index):
+        """Per-sample attribute lookup; defaults to a dataset-level attr."""
+        return getattr(self, attr, None)

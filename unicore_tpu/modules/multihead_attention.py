@@ -109,13 +109,25 @@ def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias):
     if impl == "ulysses" and h % n != 0:
         return None
 
-    if dropout > 0.0 and not _warned_seq_parallel_dropout[0]:
-        _warned_seq_parallel_dropout[0] = True
-        logging.getLogger(__name__).warning(
-            "sequence-parallel attention ignores attention_dropout=%g "
-            "(dropout masks are not coordinated across the seq axis); "
-            "hidden/FFN dropout still applies", dropout,
-        )
+    if dropout > 0.0:
+        if not parallel.sequence_parallel_allows_dropout_skip():
+            # silent regularization loss is worse than a hard stop
+            # (advisor r2): make the user choose explicitly
+            raise ValueError(
+                f"sequence-parallel attention does not implement "
+                f"attention_dropout (={dropout:g}): dropout masks are not "
+                f"coordinated across the seq axis. Either set "
+                f"--attention-dropout 0 or pass "
+                f"--seq-parallel-skip-attention-dropout to accept "
+                f"training without it (hidden/FFN dropout still applies)."
+            )
+        if not _warned_seq_parallel_dropout[0]:
+            _warned_seq_parallel_dropout[0] = True
+            logging.getLogger(__name__).warning(
+                "sequence-parallel attention skips attention_dropout=%g "
+                "(--seq-parallel-skip-attention-dropout); hidden/FFN "
+                "dropout still applies", dropout,
+            )
 
     if key_padding_mask is not None:
         key_padding_mask = key_padding_mask.astype(bool)

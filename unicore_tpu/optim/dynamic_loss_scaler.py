@@ -4,17 +4,21 @@ Parity target: ``unicore/optim/dynamic_loss_scaler.py:8-71`` — grow x2 every
 ``scale_window`` clean steps, shrink x2 on overflow subject to a tolerance
 fraction, abort below ``min_loss_scale``.
 
-Two forms:
+Two forms, functional-first:
 
-- ``DynamicLossScaler``: host-side class, behaviorally equivalent to the
-  reference (raises OverflowError on overflow / FloatingPointError at the
-  floor so the trainer's skip/abort control flow matches).
-- ``scaler_init`` / ``scaler_effective_scale`` / ``scaler_update``:
-  functional jnp version whose state lives *inside* the jitted train step,
-  so the overflow-skip needs no host round-trip (the TPU-idiomatic
-  replacement for the reference's exception-driven flow — SURVEY §7).
-  The floor abort is checked host-side when stats are read.
+- ``scaler_init`` / ``scaler_update``: the PRIMARY form — a pure jnp update
+  whose state lives *inside* the jitted train step, so the overflow-skip
+  needs no host round-trip (the TPU-idiomatic replacement for the
+  reference's exception-driven flow — SURVEY §7).  The floor abort is
+  checked host-side when stats are read.
+- ``DynamicLossScaler``: a small host-side mirror of the same policy,
+  keeping the reference's exception contract (``OverflowError`` to skip a
+  step, ``FloatingPointError`` at the floor) for code that drives scaling
+  from the host.  State is (scale, clean-streak, window overflow rate) —
+  three counters instead of the reference's four iteration markers.
 """
+
+import math
 
 import jax.numpy as jnp
 
@@ -29,53 +33,54 @@ class DynamicLossScaler:
         threshold=None,
         min_loss_scale=1e-4,
     ):
-        self.loss_scale = init_scale
+        self.loss_scale = float(init_scale)
         self.scale_factor = scale_factor
         self.scale_window = scale_window
         self.tolerance = tolerance
         self.threshold = threshold
-        self._iter = 0
-        self._last_overflow_iter = -1
-        self._last_rescale_iter = -1
-        self._overflows_since_rescale = 0
         self.min_loss_scale = min_loss_scale
+        self._clean_streak = 0      # good steps since the last grow/overflow
+        self._window_steps = 0      # steps since the last rescale
+        self._window_overflows = 0  # overflows in that window
 
     def scale(self, outputs):
         return self.loss_scale * outputs
 
     def update(self):
-        if (self._iter - self._last_overflow_iter) % self.scale_window == 0:
+        """Record one clean step; grow after ``scale_window`` of them."""
+        self._clean_streak += 1
+        self._window_steps += 1
+        if self._clean_streak >= self.scale_window:
             self.loss_scale *= self.scale_factor
-            self._last_rescale_iter = self._iter
-        self._iter += 1
-
-    def _decrease_loss_scale(self):
-        self.loss_scale /= self.scale_factor
-        if self.threshold is not None:
-            self.loss_scale = max(self.loss_scale, self.threshold)
+            self._clean_streak = 0
+            self._window_steps = 0
+            self._window_overflows = 0
 
     def check_overflow(self, grad_norm):
-        if grad_norm == float("inf") or grad_norm != grad_norm:
-            prev_scale = self.loss_scale
-            iter_since_rescale = self._iter - self._last_rescale_iter
-            self._last_overflow_iter = self._iter
-            self._overflows_since_rescale += 1
-            pct_overflow = self._overflows_since_rescale / float(iter_since_rescale)
-            if pct_overflow >= self.tolerance:
-                self._decrease_loss_scale()
-                self._last_rescale_iter = self._iter
-                self._overflows_since_rescale = 0
-            if self.loss_scale <= self.min_loss_scale:
-                self.loss_scale = prev_scale
+        """Raise OverflowError (skip step) on a non-finite grad norm,
+        shrinking the scale unless overflows are within ``tolerance`` of
+        recent steps; FloatingPointError once the floor is hit."""
+        if math.isfinite(grad_norm):
+            return
+        self._clean_streak = 0
+        self._window_steps += 1
+        self._window_overflows += 1
+        rate = self._window_overflows / self._window_steps
+        if rate >= self.tolerance:
+            shrunk = self.loss_scale / self.scale_factor
+            if self.threshold is not None:
+                shrunk = max(shrunk, self.threshold)
+            if shrunk <= self.min_loss_scale:
                 raise FloatingPointError(
-                    (
-                        "Minimum loss scale reached ({}). Your loss is probably "
-                        "exploding. Try lowering the learning rate, using gradient "
-                        "clipping or increasing the batch size."
-                    ).format(self.min_loss_scale)
+                    f"Minimum loss scale reached ({self.min_loss_scale}). "
+                    "Your loss is probably exploding. Try lowering the "
+                    "learning rate, using gradient clipping or increasing "
+                    "the batch size."
                 )
-            self._iter += 1
-            raise OverflowError("setting loss scale to: " + str(self.loss_scale))
+            self.loss_scale = shrunk
+            self._window_steps = 0
+            self._window_overflows = 0
+        raise OverflowError(f"setting loss scale to: {self.loss_scale}")
 
     def state_dict(self):
         return {"loss_scale": self.loss_scale}

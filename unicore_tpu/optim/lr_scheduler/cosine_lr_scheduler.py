@@ -1,42 +1,16 @@
-"""Cyclical cosine LR schedule with warmup (parity:
-lr_scheduler/cosine_lr_scheduler.py; SGDR, arxiv 1608.03983)."""
+"""Cyclical cosine LR with warmup (SGDR, arxiv 1608.03983): thin shim
+over ``schedules.cosine`` (behavioral parity with the reference's
+``cosine_lr_scheduler.py``)."""
 
-import math
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import cosine
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("cosine")
-class CosineLRSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        if isinstance(args.lr, (list, tuple)) and len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with cosine;"
-                " consider --lr-scheduler=fixed instead."
-            )
-        self.max_lr = args.lr[0] if isinstance(args.lr, (list, tuple)) else args.lr
-        assert self.max_lr > args.min_lr, "max_lr must be more than min_lr"
-        warmup_end_lr = self.max_lr
-        if args.warmup_init_lr < 0:
-            args.warmup_init_lr = args.min_lr
-        self.t_mult = args.t_mult
-        self.period = args.lr_period_updates
-        if self.period <= 0:
-            assert args.max_update > 0, (
-                "Either --max-update or --lr-period-updates must be set"
-            )
-            self.period = args.max_update - args.warmup_updates
-        if args.warmup_updates > 0:
-            self.lr_step = (warmup_end_lr - args.warmup_init_lr) / args.warmup_updates
-        else:
-            self.lr_step = 1
-        self.warmup_updates = args.warmup_updates
-        self.lr_shrink = args.lr_shrink
-        self.lr = args.warmup_init_lr
-        self.optimizer.set_lr(self.lr)
-
+class CosineLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--warmup-updates', default=0, type=int, metavar='N',
@@ -54,38 +28,29 @@ class CosineLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--lr-shrink', default=0.1, type=float, metavar='LS',
                             help='shrink factor for annealing')
 
-    def step(self, epoch, val_loss=None):
-        super().step(epoch, val_loss)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        if num_updates < self.args.warmup_updates:
-            self.lr = self.args.warmup_init_lr + num_updates * self.lr_step
-        else:
-            curr_updates = num_updates - self.args.warmup_updates
-            if self.t_mult != 1:
-                i = math.floor(
-                    math.log(
-                        1 - curr_updates / self.period * (1 - self.t_mult),
-                        self.t_mult,
-                    )
-                )
-                t_i = self.t_mult ** i * self.period
-                t_curr = (
-                    curr_updates
-                    - (1 - self.t_mult ** i) / (1 - self.t_mult) * self.period
-                )
-            else:
-                i = math.floor(curr_updates / self.period)
-                t_i = self.period
-                t_curr = curr_updates - (self.period * i)
-
-            lr_shrink = self.lr_shrink ** i
-            min_lr = self.args.min_lr * lr_shrink
-            max_lr = self.max_lr * lr_shrink
-            self.lr = min_lr + 0.5 * (max_lr - min_lr) * (
-                1 + math.cos(math.pi * t_curr / t_i)
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if isinstance(args.lr, (list, tuple)) and len(args.lr) > 1:
+            raise ValueError(
+                "Cannot use a fixed learning rate schedule with cosine;"
+                " consider --lr-scheduler=fixed instead."
             )
-
+        max_lr = args.lr[0] if isinstance(args.lr, (list, tuple)) else args.lr
+        if max_lr <= args.min_lr:
+            raise ValueError("max_lr must be more than min_lr")
+        if args.warmup_init_lr < 0:
+            args.warmup_init_lr = args.min_lr
+        period = args.lr_period_updates
+        if period <= 0:
+            assert args.max_update > 0, (
+                "Either --max-update or --lr-period-updates must be set"
+            )
+            period = args.max_update - args.warmup_updates
+        self._schedule = functools.partial(
+            cosine, max_lr=max_lr, min_lr=args.min_lr, period=period,
+            t_mult=args.t_mult, shrink=args.lr_shrink,
+            warmup_updates=args.warmup_updates,
+            warmup_init_lr=args.warmup_init_lr,
+        )
+        self.lr = args.warmup_init_lr
         self.optimizer.set_lr(self.lr)
-        return self.lr

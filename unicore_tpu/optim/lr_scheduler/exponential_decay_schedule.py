@@ -1,25 +1,16 @@
-"""Exponential-decay LR schedule (parity:
-lr_scheduler/exponential_decay_schedule.py)."""
+"""Exponential-decay LR: thin shim over ``schedules.exponential_decay``
+(behavioral parity with the reference's ``exponential_decay_schedule.py``,
+including ``--stair-decay``)."""
+
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import exponential_decay
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("exponential_decay")
-class ExponentialDecayLRSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        self.warmup_updates = args.warmup_updates
-        self.lr = args.lr[0]
-        if self.warmup_updates > 0:
-            self.warmup_factor = 1.0 / self.warmup_updates
-        else:
-            self.warmup_factor = 1.0
-        self.decay_ratio = args.decay_ratio
-        self.decay_steps = args.decay_steps
-        self.optimizer.set_lr(self.warmup_factor * self.lr)
-        self.stair_decay = getattr(args, "stair_decay", False)
-
+class ExponentialDecayLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--warmup-updates', default=1000, type=int, metavar='N',
@@ -28,16 +19,14 @@ class ExponentialDecayLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--decay-steps', default=500, type=int)
         parser.add_argument('--stair-decay', action="store_true")
 
-    def step_update(self, num_updates):
-        if self.warmup_updates > 0 and num_updates <= self.warmup_updates:
-            self.warmup_factor = num_updates / float(self.warmup_updates)
-            lr = self.warmup_factor * self.lr
-        else:
-            if self.stair_decay:
-                step = num_updates
-                lr = self.lr * float(self.decay_ratio ** int(step // self.decay_steps))
-            else:
-                step = num_updates - self.warmup_updates
-                lr = self.lr * float(self.decay_ratio ** float(step / self.decay_steps))
-        self.optimizer.set_lr(lr)
-        return self.optimizer.get_lr()
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        self.lr = args.lr[0]
+        self._schedule = functools.partial(
+            exponential_decay, base_lr=args.lr[0],
+            decay_ratio=args.decay_ratio, decay_steps=args.decay_steps,
+            warmup_updates=args.warmup_updates,
+            stair=getattr(args, "stair_decay", False),
+        )
+        init = 1.0 / args.warmup_updates if args.warmup_updates > 0 else 1.0
+        self.optimizer.set_lr(init * self.lr)

@@ -1,22 +1,17 @@
-"""Fixed LR schedule (parity: lr_scheduler/fixed_schedule.py)."""
+"""Fixed (epoch-listed) LR: per-update linear warmup via
+``schedules.fixed_warmup``; the epoch machinery — ``--lr`` lists and
+``--force-anneal`` shrink — is host state here (behavioral parity with the
+reference's ``fixed_schedule.py``)."""
+
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import fixed_warmup
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("fixed")
-class FixedLRSchedule(UnicoreLRScheduler):
-    """Decay the LR on a fixed per-epoch schedule (``--lr`` may be a list),
-    with optional annealing after ``--force-anneal`` and linear warmup."""
-
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        self.lr = args.lr[0]
-        if args.warmup_updates > 0:
-            self.warmup_factor = 1.0 / args.warmup_updates
-        else:
-            self.warmup_factor = 1
-
+class FixedLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--force-anneal', '--fa', type=int, metavar='N',
@@ -26,32 +21,35 @@ class FixedLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--warmup-updates', default=0, type=int, metavar='N',
                             help='warmup the learning rate linearly for the first N updates')
 
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        self._rebind(args.lr[0])
+
+    def _rebind(self, base_lr):
+        self.lr = base_lr
+        self._schedule = functools.partial(
+            fixed_warmup, base_lr=base_lr,
+            warmup_updates=self.args.warmup_updates,
+        )
+
     def state_dict(self):
         return {"lr": self.lr}
 
     def load_state_dict(self, state_dict):
         if "lr" in state_dict:
-            self.lr = state_dict["lr"]
+            self._rebind(state_dict["lr"])
 
-    def get_next_lr(self, epoch):
-        lrs = self.args.lr
-        if self.args.force_anneal is None or epoch < self.args.force_anneal:
-            next_lr = lrs[min(epoch - 1, len(lrs) - 1)]
-        else:
-            next_lr = lrs[-1] * self.args.lr_shrink ** (
-                epoch + 1 - self.args.force_anneal
-            )
-        return next_lr
+    def _epoch_lr(self, epoch):
+        lrs, fa = self.args.lr, self.args.force_anneal
+        if fa is None or epoch < fa:
+            return lrs[min(epoch - 1, len(lrs) - 1)]
+        return lrs[-1] * self.args.lr_shrink ** (epoch + 1 - fa)
 
     def step_begin_epoch(self, epoch):
-        self.lr = self.get_next_lr(epoch)
-        self.optimizer.set_lr(self.warmup_factor * self.lr)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        if self.args.warmup_updates > 0 and num_updates < self.args.warmup_updates:
-            self.warmup_factor = (num_updates + 1) / float(self.args.warmup_updates)
-            self.optimizer.set_lr(self.warmup_factor * self.lr)
-        else:
-            self.optimizer.set_lr(self.lr)
+        self._rebind(self._epoch_lr(epoch))
+        # apply the warmup factor the *previous* update count earned (the
+        # epoch hook runs between updates; the next step_update corrects)
+        w = self.args.warmup_updates
+        warm = min((self._last_step + 1) / w, 1.0) if w > 0 else 1.0
+        self.optimizer.set_lr(warm * self.lr)
         return self.optimizer.get_lr()

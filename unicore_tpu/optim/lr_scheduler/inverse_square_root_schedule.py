@@ -1,27 +1,16 @@
-"""Inverse-sqrt LR schedule with warmup (parity:
-lr_scheduler/inverse_square_root_schedule.py)."""
+"""Inverse-sqrt LR with warmup: thin shim over ``schedules.inverse_sqrt``
+(behavioral parity with the reference's
+``inverse_square_root_schedule.py``)."""
+
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import inverse_sqrt
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("inverse_sqrt")
-class InverseSquareRootSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        if isinstance(args.lr, (list, tuple)) and len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with inverse_sqrt;"
-                " consider --lr-scheduler=fixed instead."
-            )
-        warmup_end_lr = args.lr[0] if isinstance(args.lr, (list, tuple)) else args.lr
-        if args.warmup_init_lr < 0:
-            args.warmup_init_lr = 0 if args.warmup_updates > 0 else warmup_end_lr
-        self.lr_step = (warmup_end_lr - args.warmup_init_lr) / args.warmup_updates
-        self.decay_factor = warmup_end_lr * args.warmup_updates ** 0.5
-        self.lr = args.warmup_init_lr
-        self.optimizer.set_lr(self.lr)
-
+class InverseSquareRootSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--warmup-updates', default=4000, type=int, metavar='N',
@@ -29,14 +18,20 @@ class InverseSquareRootSchedule(UnicoreLRScheduler):
         parser.add_argument('--warmup-init-lr', default=-1, type=float, metavar='LR',
                             help='initial learning rate during warmup phase; default is args.lr')
 
-    def step(self, epoch, val_loss=None):
-        super().step(epoch, val_loss)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        if num_updates < self.args.warmup_updates:
-            self.lr = self.args.warmup_init_lr + num_updates * self.lr_step
-        else:
-            self.lr = self.decay_factor * num_updates ** -0.5
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if isinstance(args.lr, (list, tuple)) and len(args.lr) > 1:
+            raise ValueError(
+                "Cannot use a fixed learning rate schedule with inverse_sqrt;"
+                " consider --lr-scheduler=fixed instead."
+            )
+        base_lr = args.lr[0] if isinstance(args.lr, (list, tuple)) else args.lr
+        if args.warmup_init_lr < 0:
+            args.warmup_init_lr = 0 if args.warmup_updates > 0 else base_lr
+        self._schedule = functools.partial(
+            inverse_sqrt, base_lr=base_lr,
+            warmup_updates=args.warmup_updates,
+            warmup_init_lr=args.warmup_init_lr,
+        )
+        self.lr = args.warmup_init_lr
         self.optimizer.set_lr(self.lr)
-        return self.lr

@@ -1,28 +1,32 @@
-"""Pass-through schedule delegating to an optimizer-owned scheduler
-(parity: lr_scheduler/pass_through.py)."""
+"""Pass-through schedule: every scheduler hook is forwarded to a scheduler
+the optimizer itself owns (fills the role of the reference's
+``lr_scheduler/pass_through.py``; forwarding methods are generated rather
+than hand-written)."""
 
 from . import register_lr_scheduler
 from .unicore_lr_scheduler import UnicoreLRScheduler
 
 
+def _forward(name):
+    def method(self, *args, **kwargs):
+        return getattr(self.optimizer.lr_scheduler, name)(*args, **kwargs)
+
+    method.__name__ = name
+    method.__doc__ = f"Forward ``{name}`` to the optimizer-owned scheduler."
+    return method
+
+
 @register_lr_scheduler("pass_through")
 class PassThroughScheduleSchedule(UnicoreLRScheduler):
-    """Delegate lr scheduling to the optimizer."""
-
     def __init__(self, args, optimizer, total_train_steps):
         super().__init__(args, optimizer, total_train_steps)
-        assert (
-            getattr(optimizer, "lr_scheduler", None) is not None
-        ), "Pass-through schedule can only be used with optimizers with their own schedulers"
+        if getattr(optimizer, "lr_scheduler", None) is None:
+            raise ValueError(
+                "pass_through requires an optimizer that owns its scheduler"
+            )
 
-    def state_dict(self):
-        return self.optimizer.lr_scheduler.state_dict()
 
-    def load_state_dict(self, state_dict):
-        self.optimizer.lr_scheduler.load_state_dict(state_dict)
-
-    def step_begin_epoch(self, epoch):
-        return self.optimizer.lr_scheduler.step_begin_epoch(epoch)
-
-    def step_update(self, num_updates):
-        return self.optimizer.lr_scheduler.step_update(num_updates)
+for _name in ("state_dict", "load_state_dict", "step_begin_epoch", "step",
+              "step_update"):
+    setattr(PassThroughScheduleSchedule, _name, _forward(_name))
+del _name

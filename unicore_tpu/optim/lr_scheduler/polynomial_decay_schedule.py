@@ -1,34 +1,18 @@
-"""Polynomial-decay LR schedule (parity:
-lr_scheduler/polynomial_decay_schedule.py, including ``--warmup-ratio``
-support driven by the trainer's total_train_steps)."""
+"""Polynomial-decay LR: thin shim over ``schedules.polynomial_decay``
+(behavioral parity with the reference's ``polynomial_decay_schedule.py``,
+including ``--warmup-ratio`` driven by the trainer's total_train_steps).
+Epoch-level behavior — per-epoch ``--lr`` lists and ``--force-anneal`` —
+lives here; the per-update curve is the pure function."""
+
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import polynomial_decay
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("polynomial_decay")
-class PolynomialDecayLRSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        if self.args.warmup_ratio > 0:
-            assert total_train_steps is not None, (
-                "--warmup-ratio requires the trainer to provide total_train_steps"
-            )
-            self.warmup_updates = int(self.args.warmup_ratio * total_train_steps)
-            self.total_num_update = total_train_steps
-        else:
-            assert args.total_num_update > 0
-            self.warmup_updates = args.warmup_updates
-            self.total_num_update = args.total_num_update
-        self.lr = args.lr[0]
-        if self.warmup_updates > 0:
-            self.warmup_factor = 1.0 / self.warmup_updates
-        else:
-            self.warmup_factor = 1
-        self.end_learning_rate = args.end_learning_rate
-        self.power = args.power
-        self.optimizer.set_lr(self.warmup_factor * self.lr)
-
+class PolynomialDecayLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--force-anneal', '--fa', type=int, metavar='N',
@@ -41,31 +25,41 @@ class PolynomialDecayLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--power', default=1.0, type=float)
         parser.add_argument('--total-num-update', default=1000000, type=int)
 
-    def get_next_lr(self, epoch):
-        lrs = self.args.lr
-        if self.args.force_anneal is None or epoch < self.args.force_anneal:
-            next_lr = lrs[min(epoch, len(lrs) - 1)]
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if args.warmup_ratio > 0:
+            assert total_train_steps is not None, (
+                "--warmup-ratio requires the trainer to provide total_train_steps"
+            )
+            self.warmup_updates = int(args.warmup_ratio * total_train_steps)
+            self.total_num_update = total_train_steps
         else:
-            next_lr = self.optimizer.get_lr()
-        return next_lr
+            assert args.total_num_update > 0
+            self.warmup_updates = args.warmup_updates
+            self.total_num_update = args.total_num_update
+        self._rebind(args.lr[0])
+        init = 1.0 / self.warmup_updates if self.warmup_updates > 0 else 1.0
+        self.optimizer.set_lr(init * self.lr)
+
+    def _rebind(self, base_lr):
+        self.lr = base_lr
+        self._schedule = functools.partial(
+            polynomial_decay, base_lr=base_lr,
+            end_lr=self.args.end_learning_rate, power=self.args.power,
+            warmup_updates=self.warmup_updates,
+            total_updates=self.total_num_update,
+        )
 
     def step_begin_epoch(self, epoch):
-        self.lr = self.get_next_lr(epoch)
-        self.optimizer.set_lr(self.warmup_factor * self.lr)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        if self.warmup_updates > 0 and num_updates <= self.warmup_updates:
-            self.warmup_factor = num_updates / float(self.warmup_updates)
-            lr = self.warmup_factor * self.lr
-        elif num_updates >= self.total_num_update:
-            lr = self.end_learning_rate
-        else:
-            warmup = self.warmup_updates
-            lr_range = self.lr - self.end_learning_rate
-            pct_remaining = 1 - (num_updates - warmup) / (
-                self.total_num_update - warmup
-            )
-            lr = lr_range * pct_remaining ** self.power + self.end_learning_rate
-        self.optimizer.set_lr(lr)
+        # per-epoch base LR list; after --force-anneal the base freezes at
+        # whatever the optimizer currently runs
+        lrs = self.args.lr
+        fa = self.args.force_anneal
+        if fa is None or epoch < fa:
+            self._rebind(lrs[min(epoch, len(lrs) - 1)])
+        # warmup factor the previous update count earned (corrected by the
+        # next step_update)
+        w = self.warmup_updates
+        warm = min(max(self._last_step, 1) / w, 1.0) if w > 0 else 1.0
+        self.optimizer.set_lr(warm * self.lr)
         return self.optimizer.get_lr()

@@ -1,31 +1,16 @@
-"""Triangular cyclical LR schedule (parity:
-lr_scheduler/triangular_lr_scheduler.py; CLR, arxiv 1506.01186)."""
+"""Triangular cyclical LR (CLR, arxiv 1506.01186): thin shim over
+``schedules.triangular`` (behavioral parity with the reference's
+``triangular_lr_scheduler.py``)."""
 
-import math
+import functools
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import triangular
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("triangular")
-class TriangularLRSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        if len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with triangular;"
-                " consider --lr-scheduler=fixed instead."
-            )
-        lr = args.lr[0]
-        assert args.max_lr > lr, "max_lr must be more than lr"
-        self.min_lr = lr
-        self.max_lr = args.max_lr
-        self.stepsize = args.lr_period_updates // 2
-        self.lr_shrink = args.lr_shrink
-        self.shrink_min = args.shrink_min
-        self.lr = self.min_lr
-        self.optimizer.set_lr(self.lr)
-
+class TriangularLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--max-lr', required=True, type=float, metavar='LR',
@@ -37,19 +22,19 @@ class TriangularLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--shrink-min', action='store_true',
                             help='if set, also shrinks min lr')
 
-    def step(self, epoch, val_loss=None):
-        super().step(epoch, val_loss)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        cycle = math.floor(num_updates / (2 * self.stepsize))
-        lr_shrink = self.lr_shrink ** cycle
-        max_lr = self.max_lr * lr_shrink
-        if self.shrink_min:
-            min_lr = self.min_lr * lr_shrink
-        else:
-            min_lr = self.min_lr
-        x = abs(num_updates / self.stepsize - 2 * (cycle + 1) + 1)
-        self.lr = min_lr + (max_lr - min_lr) * max(0, (1 - x))
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if len(args.lr) > 1:
+            raise ValueError(
+                "Cannot use a fixed learning rate schedule with triangular;"
+                " consider --lr-scheduler=fixed instead."
+            )
+        if args.max_lr <= args.lr[0]:
+            raise ValueError("max_lr must be more than lr")
+        self.lr = args.lr[0]
+        self._schedule = functools.partial(
+            triangular, min_lr=args.lr[0], max_lr=args.max_lr,
+            stepsize=args.lr_period_updates // 2, shrink=args.lr_shrink,
+            shrink_min=args.shrink_min,
+        )
         self.optimizer.set_lr(self.lr)
-        return self.lr

@@ -56,3 +56,24 @@ class UnicoreLRScheduler:
     def step_update(self, num_updates):
         """Update the lr after each optimizer update. Returns the new lr."""
         return self.get_lr()
+
+
+class FunctionalLRScheduler(UnicoreLRScheduler):
+    """Shim binding a pure ``step -> lr`` function (``schedules.py``) to
+    the stateful reference scheduler API.  Subclasses set
+    ``self._schedule`` to a zero-state callable; everything else —
+    epoch hooks, checkpoint state, val-loss tracking — stays on the base
+    class.  The same callable can be handed to a jitted step for fully
+    on-device LR computation."""
+
+    _schedule = None  # set by subclass __init__: callable(step) -> lr
+    _last_step = 0    # highest update count seen (epoch hooks read it)
+
+    def schedule(self, step):
+        return self._schedule(step)
+
+    def step_update(self, num_updates):
+        self._last_step = num_updates
+        self.lr = float(self._schedule(num_updates))
+        self.optimizer.set_lr(self.lr)
+        return self.lr

@@ -25,15 +25,27 @@ from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: F401
 # is a property of the run's mesh, not of any one layer.
 # ----------------------------------------------------------------------
 
-_SEQ_PARALLEL = {"mesh": None, "impl": "ring"}
+_SEQ_PARALLEL = {"mesh": None, "impl": "ring", "allow_dropout_skip": False}
 
 
-def enable_sequence_parallel(mesh, impl="ring"):
-    """Activate sequence parallelism over ``mesh``'s ``seq`` axis."""
+def enable_sequence_parallel(mesh, impl="ring", allow_dropout_skip=False):
+    """Activate sequence parallelism over ``mesh``'s ``seq`` axis.
+
+    ``allow_dropout_skip``: sequence-parallel attention does not implement
+    attention dropout (masks would need coordination across the k/v ring);
+    by default a model configured with attention_dropout > 0 FAILS FAST
+    rather than silently training unregularized — set this to accept the
+    dropout-free behavior explicitly (``--seq-parallel-skip-attention-dropout``).
+    """
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel impl {impl!r}")
     _SEQ_PARALLEL["mesh"] = mesh
     _SEQ_PARALLEL["impl"] = impl
+    _SEQ_PARALLEL["allow_dropout_skip"] = bool(allow_dropout_skip)
+
+
+def sequence_parallel_allows_dropout_skip():
+    return _SEQ_PARALLEL["allow_dropout_skip"]
 
 
 def disable_sequence_parallel():

@@ -95,7 +95,10 @@ class Trainer:
 
         if self._mesh_shape.get("seq", 1) > 1:
             parallel.enable_sequence_parallel(
-                self.mesh, getattr(args, "seq_parallel_impl", None) or "ring"
+                self.mesh, getattr(args, "seq_parallel_impl", None) or "ring",
+                allow_dropout_skip=getattr(
+                    args, "seq_parallel_skip_attention_dropout", False
+                ),
             )
         else:
             parallel.disable_sequence_parallel()
@@ -479,15 +482,50 @@ class Trainer:
             jax.random.PRNGKey(self.seed), self._dispatch_count
         )
         self._dispatch_count += 1
-        self.state, stats = self._jit_train_step(
-            self.state, batches, jnp.asarray(weights_np), lr, rng
-        )
+        try:
+            with jax.profiler.TraceAnnotation("train_step/dispatch"):
+                self.state, stats = self._jit_train_step(
+                    self.state, batches, jnp.asarray(weights_np), lr, rng
+                )
+        except Exception:
+            # the reference logs cuda memory_summary on step failure
+            # (trainer.py:639-654); HBM stats are the TPU analogue
+            self.log_memory_stats(level=logging.ERROR)
+            raise
+
+        mem_every = int(getattr(self.args, "log_memory", 0) or 0)
+        if mem_every > 0 and self._dispatch_count % mem_every == 0:
+            ms = self._device_memory_stats()
+            if ms is not None:
+                metrics.log_scalar(
+                    "mem_gb", ms.get("bytes_in_use", 0) / 1e9,
+                    priority=710, round=2, weight=0,
+                )
 
         self._pending_stats.append((stats, weights_np, samples[0]))
         out = None
         while len(self._pending_stats) > self.stats_lag:
             out = self._process_stats(*self._pending_stats.pop(0))
         return out
+
+    def _device_memory_stats(self):
+        try:
+            return jax.local_devices()[0].memory_stats()
+        except Exception:  # backend without memory introspection
+            return None
+
+    def log_memory_stats(self, level=logging.INFO):
+        """Log the device's HBM stats (the reference's
+        ``torch.cuda.memory_summary`` analogue, trainer.py:639-654)."""
+        ms = self._device_memory_stats()
+        if not ms:
+            logger.log(level, "device memory stats unavailable")
+            return
+        logger.log(level, "device memory: %s", ", ".join(
+            f"{k}={v / 1e9:.2f}GB" if isinstance(v, (int, float)) and "bytes" in k
+            else f"{k}={v}"
+            for k, v in sorted(ms.items())
+        ))
 
     def flush_stats(self):
         """Drain pending lagged stats so num_updates/meters are exact."""
@@ -503,7 +541,8 @@ class Trainer:
 
     def _process_stats(self, stats, weights_np, first_sample):
         # host-side bookkeeping (one device->host sync per processed step)
-        stats = jax.device_get(stats)
+        with jax.profiler.TraceAnnotation("train_step/stats-sync"):
+            stats = jax.device_get(stats)
         overflow = bool(stats["overflow"] > 0)
         if overflow:
             if not self.use_scaler:
@@ -549,7 +588,11 @@ class Trainer:
         return logging_outputs
 
     def valid_step(self, sample):
-        self.flush_stats()  # exact meters/num_updates before eval
+        # NOTE: does NOT flush lagged train stats — _process_stats logs
+        # train scalars into every ACTIVE aggregator, and validation runs
+        # under a new_root context that must stay train-free.  Callers
+        # flush before opening their validation aggregator (the CLI does,
+        # unicore_tpu_cli/train.py validate()).
         if self.state is None:
             self.init_state(sample)
         if self._jit_valid_step is None:

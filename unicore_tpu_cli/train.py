@@ -21,6 +21,8 @@ from typing import Optional
 
 import numpy as np
 
+import jax
+
 from unicore_tpu import options, tasks, utils
 from unicore_tpu.checkpoint_utils import CheckpointManager
 from unicore_tpu.data import iterators
@@ -35,6 +37,20 @@ logging.basicConfig(
     stream=sys.stdout,
 )
 logger = logging.getLogger("unicore_tpu_cli.train")
+
+
+def _annotate_iter(iterable, name):
+    """Wrap each ``next()`` in a profiler TraceAnnotation so data-wait time
+    shows as a named range in captured traces (the reference's
+    ``record_function`` phase structure, unicore_cli/train.py:213-215)."""
+    it = iter(iterable)
+    while True:
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
 
 
 class TrainLoop:
@@ -74,6 +90,7 @@ class TrainLoop:
                     "stopping: %.2f training hours > --stop-time-hours %s",
                     hours, self.args.stop_time_hours,
                 )
+                self.trainer.flush_stats()  # stop -> save/validate follow
                 return True
         return False
 
@@ -142,7 +159,7 @@ class TrainLoop:
         valid_losses, stop = [None], False
         num_updates = self.trainer.get_num_updates()
         logger.info("Start iterating over samples")
-        for samples in progress:
+        for samples in _annotate_iter(progress, "train/data-wait"):
             with metrics.aggregate("train_inner"):
                 log_output = self.trainer.train_step(samples)
 
@@ -227,16 +244,21 @@ class TrainLoop:
 
         valid_losses = [None]
         if validate_now:
-            valid_losses = self.validate(epoch_itr)
+            with jax.profiler.TraceAnnotation("train/validate"):
+                valid_losses = self.validate(epoch_itr)
         stop |= self._patience_exhausted(valid_losses[0])
-        self.ckpt.save(
-            self.trainer, epoch_itr, valid_losses[0],
-            do_save=(save_now or stop),
-        )
+        with jax.profiler.TraceAnnotation("train/checkpoint"):
+            self.ckpt.save(
+                self.trainer, epoch_itr, valid_losses[0],
+                do_save=(save_now or stop),
+            )
         return valid_losses, stop
 
     def validate(self, epoch_itr):
         """Run every validation subset; returns the checkpoint-metric values."""
+        # drain lagged train stats BEFORE the new_root aggregator below —
+        # flushing inside it would log train scalars into the valid meters
+        self.trainer.flush_stats()
         self.task.begin_valid_epoch(epoch_itr.epoch, self.trainer.model)
         losses = []
         for subset in self.valid_subsets:
