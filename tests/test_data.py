@@ -250,3 +250,25 @@ def test_counting_iterator_skip_take():
     assert itr.n == 3
     itr.take(5)
     assert list(itr) == [3, 4]
+
+
+def test_process_worker_pool_matches_thread():
+    """--worker-impl process: forked worker processes produce the identical
+    batch stream (order and content) as threads and as no workers."""
+    n, batch = 12, 2
+    base = ListDataset([np.array([i]) for i in range(n)])
+    sampler = data_utils.batch_by_size(np.arange(n), batch_size=batch)
+
+    def run():
+        it = iterators.EpochBatchIterator(
+            dataset=base, collate_fn=base.collater, batch_sampler=sampler,
+            seed=1, num_workers=2,
+        )
+        return [b.tolist() for b in it.next_epoch_itr(shuffle=True)]
+
+    baseline = run()
+    iterators.set_worker_impl("process")
+    try:
+        assert run() == baseline
+    finally:
+        iterators.set_worker_impl("thread")

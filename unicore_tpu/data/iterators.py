@@ -12,10 +12,11 @@ Independent implementation: the reference stacks four wrappers
 (DataLoader -> Buffered -> Sharded -> Counting) around a stateful epoch
 object; here one :class:`_EpochStream` owns a shard's batch plan, cursor,
 worker pool, and prefetch thread, and :class:`EpochBatchIterator` is a
-thin orchestrator that plans epochs and (de)serializes position.  Batches
-are materialized by a thread pool rather than worker subprocesses: the
-collation path is numpy (GIL-releasing) over mmap-backed record stores,
-where processes buy isolation nothing needs and lose zero-copy reads.
+thin orchestrator that plans epochs and (de)serializes position.  Two
+worker-pool implementations (``set_worker_impl``): ``thread`` (default —
+zero-copy, ideal for numpy collation over mmap-backed record stores,
+GIL-bound for CPU-heavy transforms) and ``process`` (the reference's
+DataLoader-worker model, for tokenize-heavy pipelines).
 """
 
 import itertools
@@ -23,7 +24,8 @@ import logging
 import math
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+import multiprocessing
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 
 import numpy as np
 
@@ -170,6 +172,20 @@ class _EpochStream:
         self.num_workers = num_workers
         self.buffer_size = buffer_size
         self._iter = None
+        self._pool = None
+        if num_workers > 0 and worker_impl() == "process":
+            # fork the worker processes HERE, on the construction (main)
+            # thread — _produce's generator body runs on the prefetch pump
+            # thread when buffer_size > 0, and forking a multithreaded
+            # process from a daemon thread is a deadlock window.  The
+            # warmup submit forces the lazy fork to happen now.
+            self._pool = ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=multiprocessing.get_context("fork"),
+                initializer=_process_worker_init,
+                initargs=(dataset, collate_fn),
+            )
+            self._pool.submit(int, 0).result()
 
     def __len__(self):
         return self.total
@@ -203,25 +219,71 @@ class _EpochStream:
             yield batch
 
     def _pooled(self, todo):
-        """Materialize with a thread pool, at most ~2x workers in flight so
-        loading can't run an entire epoch ahead of the consumer."""
+        """Materialize with a worker pool, at most ~2x workers in flight so
+        loading can't run an entire epoch ahead of the consumer.
+
+        Two pool implementations (``set_worker_impl``):
+
+        - ``thread`` (default): zero-copy, fine for IO-bound pipelines
+          (LMDB/record byte reads) but GIL-bound for CPU-heavy transforms;
+        - ``process``: fork-context worker PROCESSES (the reference's
+          DataLoader-worker model, ``unicore/data/iterators.py:389-395``)
+          — the dataset/collater ship to each worker once via the pool
+          initializer, per-batch traffic is index lists in and pickled
+          numpy batches out.  Use for tokenize-heavy pipelines.
+        """
         window = 2 * self.num_workers
-        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        if self._pool is not None:  # process pool, forked at __init__
+            pool = self._pool
+            submit = lambda b: pool.submit(
+                _process_worker_load, [int(i) for i in b]
+            )
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            submit = lambda b: pool.submit(self._load, b)
         try:
             backlog = iter(todo)
             inflight = [
-                pool.submit(self._load, b)
-                for b in itertools.islice(backlog, window)
+                submit(b) for b in itertools.islice(backlog, window)
             ]
             inflight.reverse()  # pop() from the tail = FIFO order
             while inflight:
                 done = inflight.pop()
                 nxt = next(backlog, None)
                 if nxt is not None:
-                    inflight.insert(0, pool.submit(self._load, nxt))
+                    inflight.insert(0, submit(nxt))
                 yield done.result()
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
+
+
+_WORKER_IMPL = "thread"
+_PROCESS_WORKER = {"dataset": None, "collate": None}
+
+
+def set_worker_impl(impl):
+    """Select the data-worker pool implementation: ``thread`` | ``process``
+    (``--worker-impl``; consulted when ``num_workers > 0``)."""
+    global _WORKER_IMPL
+    if impl not in ("thread", "process"):
+        raise ValueError(f"unknown worker impl {impl!r}")
+    _WORKER_IMPL = impl
+
+
+def worker_impl():
+    return _WORKER_IMPL
+
+
+def _process_worker_init(dataset, collate_fn):
+    _PROCESS_WORKER["dataset"] = dataset
+    _PROCESS_WORKER["collate"] = collate_fn
+
+
+def _process_worker_load(indices):
+    if len(indices) == 0:
+        return {}  # lockstep dummy; trainer assigns it zero weight
+    ds = _PROCESS_WORKER["dataset"]
+    return _PROCESS_WORKER["collate"]([ds[i] for i in indices])
 
 
 def _prefetch_thread(source, depth):

@@ -166,6 +166,10 @@ def _softmax_dropout_fwd_impl(x, mask, bias, dropout_prob, seed, save_softmax):
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            # every softmax row block is independent
+            dimension_semantics=("parallel",) * len(grid),
+        ),
     )(*args)
     if save_softmax:
         return results[0], results[1]
@@ -204,6 +208,9 @@ def _bwd(dropout_prob, residuals, g):
         out_specs=[xs],
         out_shape=[jax.ShapeDtypeStruct(x_shape, sm.dtype)],
         interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * len(grid),
+        ),
     )(jnp.atleast_1d(jnp.asarray(seed, dtype=jnp.int32)), g, sm)[0]
 
     def reduce_to(shape):

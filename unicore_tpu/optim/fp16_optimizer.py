@@ -30,6 +30,7 @@ def make_master_params(params):
     return jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
 
 
+@jax.custom_vjp
 def _sr_cast_straight_through(master_leaf, key):
     """fp32 -> bf16 stochastic-rounding cast with a straight-through
     gradient (d(out)/d(master) = 1).
@@ -39,11 +40,21 @@ def _sr_cast_straight_through(master_leaf, key):
     analogue of the reference's post-step master->model SR sync,
     fp16_optimizer.py:146-148), so gradients must flow through to the
     fp32 master as identity — exactly what autograd-through-a-cast does
-    in the reference."""
-    sr = ops.fp32_to_bf16_sr(master_leaf, key).astype(jnp.float32)
-    return (
-        master_leaf + jax.lax.stop_gradient(sr - master_leaf)
-    ).astype(jnp.bfloat16)
+    in the reference.  custom_vjp (not a stop_gradient trick) so the
+    Pallas kernel is never traced inside JVP machinery — Mosaic's
+    tracing env rejects that on TPU (grid-context assertion)."""
+    return ops.fp32_to_bf16_sr(master_leaf, key)
+
+
+def _sr_cast_fwd(master_leaf, key):
+    return _sr_cast_straight_through(master_leaf, key), None
+
+
+def _sr_cast_bwd(_, g):
+    return g.astype(jnp.float32), None  # identity to master; key non-diff
+
+
+_sr_cast_straight_through.defvjp(_sr_cast_fwd, _sr_cast_bwd)
 
 
 def sync_master_to_model(master, model_dtype, sr_rng=None):

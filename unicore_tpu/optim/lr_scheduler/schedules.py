@@ -145,6 +145,31 @@ def triangular(step, *, min_lr, max_lr, stepsize, shrink, shrink_min):
     return lo + (hi - lo) * frac
 
 
+def _exp(x):
+    if _traced(x):
+        import jax.numpy as jnp
+
+        return jnp.exp(x)
+    return math.exp(x)
+
+
+def tri_stage(step, *, init_lr, peak_lr, final_lr, warmup_steps, hold_steps,
+              decay_steps, decay_factor):
+    """Warmup -> hold -> exponential decay -> floor (SpecAugment, arxiv
+    1904.08779; parity: ``tri_stage_lr_scheduler.py``).  Boundaries: the
+    decay stage is inclusive of its last step."""
+    ramp = (
+        init_lr + (peak_lr - init_lr) * (step / warmup_steps)
+        if warmup_steps > 0 else peak_lr
+    )
+    t_decay = step - warmup_steps - hold_steps
+    decayed = peak_lr * _exp(-decay_factor * _where(t_decay > 0, t_decay, 0))
+    out = _where(step <= warmup_steps + hold_steps + decay_steps,
+                 decayed, final_lr)
+    out = _where(step < warmup_steps + hold_steps, peak_lr, out)
+    return _where(step < warmup_steps, ramp, out)
+
+
 def fixed_warmup(step, *, base_lr, warmup_updates):
     """The per-update part of the ``fixed`` schedule: linear warmup onto
     the (epoch-driven) base LR (parity: ``fixed_schedule.py``)."""

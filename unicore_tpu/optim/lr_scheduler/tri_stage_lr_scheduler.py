@@ -1,51 +1,18 @@
-"""Tri-stage (warmup/hold/decay) LR schedule (parity:
-lr_scheduler/tri_stage_lr_scheduler.py; SpecAugment, arxiv 1904.08779)."""
+"""Tri-stage (warmup/hold/decay) LR: thin shim over
+``schedules.tri_stage`` (behavioral parity with the reference's
+``tri_stage_lr_scheduler.py``; SpecAugment, arxiv 1904.08779)."""
 
+import ast
+import functools
 import math
 
 from . import register_lr_scheduler
-from .unicore_lr_scheduler import UnicoreLRScheduler
+from .schedules import tri_stage
+from .unicore_lr_scheduler import FunctionalLRScheduler
 
 
 @register_lr_scheduler("tri_stage")
-class TriStageLRSchedule(UnicoreLRScheduler):
-    def __init__(self, args, optimizer, total_train_steps):
-        super().__init__(args, optimizer, total_train_steps)
-        if len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with tri-stage lr;"
-                " consider --lr-scheduler=fixed instead."
-            )
-        self.peak_lr = args.lr[0]
-        self.init_lr = args.init_lr_scale * args.lr[0]
-        self.final_lr = args.final_lr_scale * args.lr[0]
-        if args.phase_ratio is not None:
-            assert args.max_update > 0
-            phase_ratio = (
-                eval(args.phase_ratio)
-                if isinstance(args.phase_ratio, str)
-                else args.phase_ratio
-            )
-            assert sum(phase_ratio) == 1, "phase ratios must add up to 1"
-            self.warmup_steps = int(args.max_update * phase_ratio[0])
-            self.hold_steps = int(args.max_update * phase_ratio[1])
-            self.decay_steps = int(args.max_update * phase_ratio[2])
-        else:
-            self.warmup_steps = args.warmup_steps
-            self.hold_steps = args.hold_steps
-            self.decay_steps = args.decay_steps
-        assert (
-            self.warmup_steps + self.hold_steps + self.decay_steps > 0
-        ), "please specify steps or phase_ratio"
-        self.warmup_rate = (
-            (self.peak_lr - self.init_lr) / self.warmup_steps
-            if self.warmup_steps != 0
-            else 0
-        )
-        self.decay_factor = -math.log(args.final_lr_scale) / self.decay_steps
-        self.lr = self.init_lr
-        self.optimizer.set_lr(self.lr)
-
+class TriStageLRSchedule(FunctionalLRScheduler):
     @classmethod
     def add_args(cls, parser):
         parser.add_argument('--warmup-steps', default=4000, type=int, metavar='N',
@@ -61,33 +28,36 @@ class TriStageLRSchedule(UnicoreLRScheduler):
         parser.add_argument('--final-lr-scale', default=0.01, type=float,
                             help='final learning rate scale')
 
-    def _decide_stage(self, update_step):
-        if update_step < self.warmup_steps:
-            return 0, update_step
-        offset = self.warmup_steps
-        if update_step < offset + self.hold_steps:
-            return 1, update_step - offset
-        offset += self.hold_steps
-        if update_step <= offset + self.decay_steps:
-            return 2, update_step - offset
-        offset += self.decay_steps
-        return 3, update_step - offset
-
-    def step(self, epoch, val_loss=None):
-        super().step(epoch, val_loss)
-        return self.optimizer.get_lr()
-
-    def step_update(self, num_updates):
-        stage, steps_in_stage = self._decide_stage(num_updates)
-        if stage == 0:
-            self.lr = self.init_lr + self.warmup_rate * steps_in_stage
-        elif stage == 1:
-            self.lr = self.peak_lr
-        elif stage == 2:
-            self.lr = self.peak_lr * math.exp(-self.decay_factor * steps_in_stage)
-        elif stage == 3:
-            self.lr = self.final_lr
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        if len(args.lr) > 1:
+            raise ValueError(
+                "Cannot use a fixed learning rate schedule with tri-stage lr;"
+                " consider --lr-scheduler=fixed instead."
+            )
+        peak = args.lr[0]
+        if args.phase_ratio is not None:
+            if not args.max_update > 0:
+                raise ValueError("--phase-ratio needs --max-update")
+            ratios = (
+                ast.literal_eval(args.phase_ratio)  # never eval() user input
+                if isinstance(args.phase_ratio, str) else args.phase_ratio
+            )
+            if sum(ratios) != 1:
+                raise ValueError("phase ratios must add up to 1")
+            warmup, hold, decay = (int(args.max_update * r) for r in ratios)
         else:
-            raise ValueError("Undefined stage")
+            warmup, hold, decay = (
+                args.warmup_steps, args.hold_steps, args.decay_steps
+            )
+        if warmup + hold + decay <= 0:
+            raise ValueError("please specify steps or phase_ratio")
+        self._schedule = functools.partial(
+            tri_stage,
+            init_lr=args.init_lr_scale * peak, peak_lr=peak,
+            final_lr=args.final_lr_scale * peak,
+            warmup_steps=warmup, hold_steps=hold, decay_steps=decay,
+            decay_factor=-math.log(args.final_lr_scale) / max(decay, 1),
+        )
+        self.lr = args.init_lr_scale * peak
         self.optimizer.set_lr(self.lr)
-        return self.lr

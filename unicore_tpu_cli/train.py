@@ -320,6 +320,22 @@ def _with_wall(stats):
 
 def main(args) -> None:
     utils.import_user_module(args)
+    iterators.set_worker_impl(getattr(args, "worker_impl", "thread"))
+    if getattr(args, "batch_size_per_device", None):
+        if args.batch_size is not None:
+            raise ValueError(
+                "--batch-size and --batch-size-per-device are exclusive"
+            )
+        args.batch_size = args.batch_size_per_device * jax.local_device_count()
+        args.batch_size_valid = (
+            getattr(args, "batch_size_valid", None) or args.batch_size
+        )
+        logger.info(
+            "--batch-size-per-device %d x %d local devices -> "
+            "--batch-size %d per host",
+            args.batch_size_per_device, jax.local_device_count(),
+            args.batch_size,
+        )
     if args.batch_size is None:
         raise ValueError("--batch-size is required")
     if not args.loss:
