@@ -1,0 +1,60 @@
+"""Token-weighted causal-LM cross entropy, registered FROM the plugin —
+demonstrates that ``--user-dir`` code can register losses, not just
+tasks/models (same registry the built-in losses use).
+
+Differs from the built-in ``cross_entropy`` (which sums every position
+and normalizes by batch): here pad positions carry zero weight and
+``sample_size`` is the real-token count, so the reported loss is
+per-token (log2 -> bits-per-token; ``ppl`` derived)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import metrics
+from unicore_tpu.losses import UnicoreLoss, register_loss
+
+
+@register_loss("lm_cross_entropy")
+class LMCrossEntropyLoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        target = sample["target"]
+        weight = (target != self.padding_idx).astype(jnp.float32)
+        logits = model.apply(
+            {"params": params},
+            **sample["net_input"],
+            deterministic=not is_training,
+            rngs={"dropout": rng} if (is_training and rng is not None) else None,
+        )
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            lprobs, jnp.where(target != self.padding_idx, target, 0)[..., None],
+            axis=-1,
+        )[..., 0]
+        loss = jnp.sum(nll * weight)
+        sample_size = jnp.sum(weight)
+        logging_output = {
+            "loss": loss,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+            "sample_size": sample_size,
+            "n_tokens": sample_size,
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="valid") -> None:
+        loss_sum = sum(float(l.get("loss", 0)) for l in logging_outputs)
+        n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+        metrics.log_scalar("loss", loss_sum / n / math.log(2), n, round=3)
+        metrics.log_derived(
+            "ppl", lambda m: float(2 ** min(m["loss"].avg, 30)), priority=200
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

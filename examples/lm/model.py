@@ -1,0 +1,140 @@
+"""Decoder-only transformer LM.
+
+Structure mirrors the BERT example (``examples/bert/model.py``) but on
+``TransformerDecoder`` (causal mask via ``auto_regressive``, no
+cross-attention): token + learned position embeddings, pre-LN decoder with
+bucketed rel-pos bias, tied-weight output projection.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu.models import (
+    BaseUnicoreModel,
+    register_model,
+    register_model_architecture,
+)
+from unicore_tpu.modules import LayerNorm, TransformerDecoder, bert_init
+from unicore_tpu.utils import get_activation_fn
+
+
+def _embed_init_with_zero_pad(padding_idx):
+    base = nn.initializers.normal(stddev=0.02)
+
+    def init(key, shape, dtype=jnp.float32):
+        return base(key, shape, dtype).at[padding_idx].set(0.0)
+
+    return init
+
+
+@register_model("transformer_lm")
+class TransformerLMModel(BaseUnicoreModel):
+    vocab_size: int = 30522
+    padding_idx: int = 0
+    decoder_layers: int = 6
+    decoder_embed_dim: int = 512
+    decoder_ffn_embed_dim: int = 2048
+    decoder_attention_heads: int = 8
+    emb_dropout: float = 0.1
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 512
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("--decoder-layers", type=int, metavar="L")
+        parser.add_argument("--decoder-embed-dim", type=int, metavar="H")
+        parser.add_argument("--decoder-ffn-embed-dim", type=int, metavar="F")
+        parser.add_argument("--decoder-attention-heads", type=int, metavar="A")
+        parser.add_argument("--activation-fn")
+        parser.add_argument("--emb-dropout", type=float, metavar="D")
+        parser.add_argument("--dropout", type=float, metavar="D")
+        parser.add_argument("--attention-dropout", type=float, metavar="D")
+        parser.add_argument("--activation-dropout", type=float, metavar="D")
+        parser.add_argument("--max-seq-len", type=int)
+        parser.add_argument("--post-ln", type=bool)
+
+    @classmethod
+    def build_model(cls, args, task):
+        return cls(
+            vocab_size=len(task.dictionary),
+            padding_idx=task.dictionary.pad(),
+            decoder_layers=args.decoder_layers,
+            decoder_embed_dim=args.decoder_embed_dim,
+            decoder_ffn_embed_dim=args.decoder_ffn_embed_dim,
+            decoder_attention_heads=args.decoder_attention_heads,
+            emb_dropout=args.emb_dropout,
+            dropout=args.dropout,
+            attention_dropout=args.attention_dropout,
+            activation_dropout=args.activation_dropout,
+            max_seq_len=args.max_seq_len,
+            activation_fn=args.activation_fn,
+            post_ln=args.post_ln,
+        )
+
+    @nn.compact
+    def __call__(self, src_tokens, deterministic=True, **kwargs):
+        padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
+        embed = nn.Embed(
+            self.vocab_size,
+            self.decoder_embed_dim,
+            embedding_init=_embed_init_with_zero_pad(self.padding_idx),
+            name="embed_tokens",
+        )
+        x = embed(src_tokens)
+        pos = self.param(
+            "embed_positions", bert_init,
+            (self.max_seq_len, self.decoder_embed_dim), jnp.float32,
+        )
+        x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
+
+        x = TransformerDecoder(
+            decoder_layers=self.decoder_layers,
+            embed_dim=self.decoder_embed_dim,
+            ffn_embed_dim=self.decoder_ffn_embed_dim,
+            attention_heads=self.decoder_attention_heads,
+            emb_dropout=self.emb_dropout,
+            dropout=self.dropout,
+            attention_dropout=self.attention_dropout,
+            activation_dropout=self.activation_dropout,
+            max_seq_len=self.max_seq_len,
+            activation_fn=self.activation_fn,
+            rel_pos=True,
+            post_ln=self.post_ln,
+            auto_regressive=True,
+            name="decoder",
+        )(x, padding_mask=padding_mask, deterministic=deterministic)
+
+        # tied projection + final LN'd features -> logits
+        x = LayerNorm(self.decoder_embed_dim, name="out_layer_norm")(x)
+        x = get_activation_fn(self.activation_fn)(x)
+        logits = embed.attend(x)
+        bias = self.param("out_bias", nn.initializers.zeros, (self.vocab_size,))
+        return logits + bias
+
+
+@register_model_architecture("transformer_lm", "transformer_lm")
+def base_lm_architecture(args):
+    args.decoder_layers = getattr(args, "decoder_layers", 6)
+    args.decoder_embed_dim = getattr(args, "decoder_embed_dim", 512)
+    args.decoder_ffn_embed_dim = getattr(args, "decoder_ffn_embed_dim", 2048)
+    args.decoder_attention_heads = getattr(args, "decoder_attention_heads", 8)
+    args.dropout = getattr(args, "dropout", 0.1)
+    args.emb_dropout = getattr(args, "emb_dropout", 0.1)
+    args.attention_dropout = getattr(args, "attention_dropout", 0.1)
+    args.activation_dropout = getattr(args, "activation_dropout", 0.0)
+    args.max_seq_len = getattr(args, "max_seq_len", 512)
+    args.activation_fn = getattr(args, "activation_fn", "gelu")
+    args.post_ln = getattr(args, "post_ln", False)
+
+
+@register_model_architecture("transformer_lm", "transformer_lm_base")
+def lm_base_architecture(args):
+    args.decoder_layers = getattr(args, "decoder_layers", 12)
+    args.decoder_embed_dim = getattr(args, "decoder_embed_dim", 768)
+    args.decoder_ffn_embed_dim = getattr(args, "decoder_ffn_embed_dim", 3072)
+    args.decoder_attention_heads = getattr(args, "decoder_attention_heads", 12)
+    base_lm_architecture(args)

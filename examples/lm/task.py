@@ -1,0 +1,91 @@
+"""Causal LM task: next-token prediction over record stores.
+
+Pipeline: record store -> tokenize -> (input = [bos, t_0..t_{n-1}],
+target = [t_0..t_{n-1}, eos]) -> pad to max_seq_len -> shuffle.  Same
+static-shape discipline as the BERT task (one jit compile for the run).
+"""
+
+import logging
+import os
+
+import numpy as np
+
+from unicore_tpu.data import (
+    AppendTokenDataset,
+    Dictionary,
+    NestedDictionaryDataset,
+    PrependTokenDataset,
+    RightPadDataset,
+    SortDataset,
+    TokenizeDataset,
+    best_record_dataset,
+    data_utils,
+)
+from unicore_tpu.tasks import UnicoreTask, register_task
+
+logger = logging.getLogger(__name__)
+
+
+@register_task("lm")
+class LMTask(UnicoreTask):
+    """Train a causal (left-to-right) language model."""
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("data", help="directory with {split}.rec and dict.txt")
+
+    def __init__(self, args, dictionary):
+        super().__init__(args)
+        self.dictionary = dictionary
+        self.seed = args.seed
+
+    @classmethod
+    def setup_task(cls, args, **kwargs):
+        dictionary = Dictionary.load(os.path.join(args.data, "dict.txt"))
+        logger.info("dictionary: {} types".format(len(dictionary)))
+        return cls(args, dictionary)
+
+    def load_dataset(self, split, combine=False, **kwargs):
+        split_path = os.path.join(self.args.data, split)
+        for ext in (".lmdb", ".rec"):
+            if os.path.exists(split_path + ext) or os.path.exists(
+                split_path + ext + ".idx"
+            ):
+                split_path = split_path + ext
+                break
+
+        # max_seq_len - 1 tokens of text, so bos/eos fit the padded length
+        tokens = TokenizeDataset(
+            best_record_dataset(split_path), self.dictionary,
+            max_seq_len=self.args.max_seq_len - 1,
+        )
+        inputs = PrependTokenDataset(tokens, self.dictionary.bos())
+        targets = AppendTokenDataset(tokens, self.dictionary.eos())
+
+        with data_utils.numpy_seed(self.args.seed):
+            shuffle = np.random.permutation(len(tokens))
+
+        self.datasets[split] = SortDataset(
+            NestedDictionaryDataset(
+                {
+                    "net_input": {
+                        "src_tokens": RightPadDataset(
+                            inputs,
+                            pad_idx=self.dictionary.pad(),
+                            pad_to_length=self.args.max_seq_len,
+                        )
+                    },
+                    "target": RightPadDataset(
+                        targets,
+                        pad_idx=self.dictionary.pad(),
+                        pad_to_length=self.args.max_seq_len,
+                    ),
+                },
+            ),
+            sort_order=[shuffle],
+        )
+
+    def build_model(self, args):
+        from unicore_tpu import models
+
+        return models.build_model(args, self)

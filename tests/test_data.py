@@ -272,3 +272,43 @@ def test_process_worker_pool_matches_thread():
         assert run() == baseline
     finally:
         iterators.set_worker_impl("thread")
+
+
+def test_process_worker_resume_sees_current_epoch():
+    """Resume with --worker-impl process: the worker fork happens AFTER
+    set_epoch, so epoch-dependent datasets collate with the resumed epoch
+    (regression: workers were forked with stale epoch-1 state)."""
+
+    class EpochEcho(ListDataset):
+        def __init__(self, n):
+            super().__init__([np.array([0])] * n)
+            self.epoch = 1
+
+        def set_epoch(self, epoch):
+            self.epoch = epoch
+
+        def __getitem__(self, idx):
+            return np.array([self.epoch * 100 + idx])
+
+    def build():
+        ds = EpochEcho(8)
+        return ds, iterators.EpochBatchIterator(
+            dataset=ds, collate_fn=ds.collater,
+            batch_sampler=data_utils.batch_by_size(np.arange(8), batch_size=2),
+            seed=1, num_workers=2, epoch=3,
+        )
+
+    iterators.set_worker_impl("process")
+    try:
+        _, it1 = build()
+        epoch_itr = it1.next_epoch_itr(shuffle=False)
+        next(epoch_itr)  # consume one batch -> mid-epoch
+        state = it1.state_dict()
+
+        _, it2 = build()
+        it2.load_state_dict(state)
+        batch = next(it2.next_epoch_itr(shuffle=False))
+        # values are epoch*100 + idx: must reflect epoch 3, not a stale 1
+        assert all(300 <= v < 400 for v in np.asarray(batch).ravel()), batch
+    finally:
+        iterators.set_worker_impl("thread")

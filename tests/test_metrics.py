@@ -70,3 +70,18 @@ def test_jax_scalar_coercion():
     with metrics.aggregate("train"):
         metrics.log_scalar("loss", jnp.float32(2.0), weight=jnp.int32(2))
     assert metrics.get_smoothed_value("train", "loss") == pytest.approx(2.0)
+
+
+def test_log_scalar_does_not_clobber_derived_meter():
+    """A scalar logged under a derived key is ignored (the trainer
+    re-logs reduced stats dicts that can include derived entries, e.g. a
+    loss's ppl)."""
+    from unicore_tpu import metrics
+
+    metrics.reset()
+    with metrics.aggregate("t") as agg:
+        metrics.log_scalar("loss", 2.0, 1)
+        metrics.log_derived("ppl", lambda m: 2 ** m["loss"].avg)
+        metrics.log_scalar("ppl", 123.0)  # must not crash or clobber
+        vals = agg.get_smoothed_values()
+    assert vals["ppl"] == 4.0

@@ -458,6 +458,13 @@ class EpochBatchIterator:
             )
             position = rescaled
         if position > 0:
+            # epoch state must be applied BEFORE the stream is built:
+            # _EpochStream.__init__ forks the process worker pool (under
+            # --worker-impl process), snapshotting the dataset — forking
+            # first would bake stale epoch-1 masking/shuffle state into
+            # every resumed worker
+            if hasattr(self.dataset, "set_epoch"):
+                self.dataset.set_epoch(self.epoch)
             self._resumed = self._open_stream(
                 self.epoch, state_dict.get("shuffle", True), offset=position
             )

@@ -85,11 +85,19 @@ def get_active_aggregators() -> List[MetersDict]:
 
 
 def log_scalar(key: str, value: float, weight: float = 1, priority: int = 10, round: Optional[int] = None):
-    """Log a scalar value into every active aggregator (weighted average)."""
+    """Log a scalar value into every active aggregator (weighted average).
+
+    A key held by a derived meter (``log_derived``) is left alone: its
+    value is recomputed from other meters at read time, so a scalar
+    arriving under the same name (e.g. the trainer re-logging a reduced
+    stats dict that includes derived entries) must not clobber it."""
     for agg in get_active_aggregators():
         if key not in agg:
             agg.add_meter(key, AverageMeter(round=round), priority)
-        agg[key].update(value, weight)
+        meter = agg[key]
+        if isinstance(meter, MetersDict._DerivedMeter):
+            continue
+        meter.update(value, weight)
 
 
 def log_scalar_sum(key: str, value: float, priority: int = 10, round: Optional[int] = None):
