@@ -22,7 +22,7 @@ from unicore_tpu.models import (
     register_model_architecture,
 )
 from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
-from unicore_tpu.utils import get_activation_fn
+from unicore_tpu.utils import eval_bool, get_activation_fn
 
 
 class BertLMHead(nn.Module):
@@ -126,7 +126,8 @@ class BertModel(BaseUnicoreModel):
                             help="dropout probability in the masked_lm pooler layers")
         parser.add_argument("--max-seq-len", type=int,
                             help="number of positional embeddings to learn")
-        parser.add_argument("--post-ln", type=bool,
+        # NOT type=bool: bool("False") is True — eval_bool parses the text
+        parser.add_argument("--post-ln", type=eval_bool,
                             help="use post layernorm or pre layernorm")
         parser.add_argument("--checkpoint-activations", action="store_true",
                             help="rematerialize encoder-layer activations in backward")

@@ -15,7 +15,7 @@ from unicore_tpu.models import (
     register_model_architecture,
 )
 from unicore_tpu.modules import LayerNorm, TransformerDecoder, bert_init
-from unicore_tpu.utils import get_activation_fn
+from unicore_tpu.utils import eval_bool, get_activation_fn
 
 
 def _embed_init_with_zero_pad(padding_idx):
@@ -55,7 +55,8 @@ class TransformerLMModel(BaseUnicoreModel):
         parser.add_argument("--attention-dropout", type=float, metavar="D")
         parser.add_argument("--activation-dropout", type=float, metavar="D")
         parser.add_argument("--max-seq-len", type=int)
-        parser.add_argument("--post-ln", type=bool)
+        # NOT type=bool: bool("False") is True — eval_bool parses the text
+        parser.add_argument("--post-ln", type=eval_bool)
 
     @classmethod
     def build_model(cls, args, task):

@@ -13,11 +13,13 @@ import numpy as np
 from unicore_tpu.data import (
     AppendTokenDataset,
     Dictionary,
+    LRUCacheDataset,
     NestedDictionaryDataset,
     PrependTokenDataset,
     RightPadDataset,
     SortDataset,
     TokenizeDataset,
+    TruncateDataset,
     best_record_dataset,
     data_utils,
 )
@@ -54,11 +56,16 @@ class LMTask(UnicoreTask):
                 split_path = split_path + ext
                 break
 
-        # max_seq_len - 1 tokens of text, so bos/eos fit the padded length
-        tokens = TokenizeDataset(
-            best_record_dataset(split_path), self.dictionary,
-            max_seq_len=self.args.max_seq_len - 1,
-        )
+        # truncate raw lines to max_seq_len - 1 tokens so bos/eos fit the
+        # padded length (long corpus lines are clipped, not rejected);
+        # LRU-cache the tokenized sample — the input and target leaves
+        # both read it, and the cache halves the vec_index work
+        tokens = LRUCacheDataset(TokenizeDataset(
+            TruncateDataset(
+                best_record_dataset(split_path), self.args.max_seq_len - 1
+            ),
+            self.dictionary, max_seq_len=self.args.max_seq_len,
+        ))
         inputs = PrependTokenDataset(tokens, self.dictionary.bos())
         targets = AppendTokenDataset(tokens, self.dictionary.eos())
 

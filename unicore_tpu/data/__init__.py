@@ -33,6 +33,7 @@ from .token_datasets import (  # noqa
     RawLabelDataset,
     RawNumpyDataset,
     TokenizeDataset,
+    TruncateDataset,
 )
 
 __all__ = [
@@ -61,6 +62,7 @@ __all__ = [
     "RightPadDataset2D",
     "SortDataset",
     "TokenizeDataset",
+    "TruncateDataset",
     "UnicoreDataset",
     "best_record_dataset",
 ]

@@ -35,6 +35,20 @@ class PrependTokenDataset(BaseWrapperDataset):
         return item
 
 
+class TruncateDataset(BaseWrapperDataset):
+    """Clip every 1-D sample to its first ``max_len`` items (e.g. so long
+    corpus lines fit the model's static sequence budget instead of
+    tripping TokenizeDataset's length check)."""
+
+    def __init__(self, dataset, max_len):
+        super().__init__(dataset)
+        self.max_len = max_len
+
+    def __getitem__(self, idx):
+        item = self.dataset[idx]
+        return item[: self.max_len]
+
+
 class TokenizeDataset(BaseWrapperDataset):
     """Map raw string/symbol sequences to int64 ids through a Dictionary."""
 
