@@ -276,12 +276,19 @@ def eval_str_list(x, type=float):
 
 
 def eval_bool(x, default=False):
+    """Parse a boolean-ish CLI/config value.  Text matching, NOT eval():
+    CLI input must never execute code, ``"false"``/``"False"``/``"0"``
+    must all mean False, and unknown text falls back to ``default``."""
     if x is None:
         return default
-    try:
-        return bool(eval(x))
-    except (TypeError, SyntaxError):
-        return default
+    if isinstance(x, bool):
+        return x
+    s = str(x).strip().lower()
+    if s in ("true", "t", "yes", "y", "1"):
+        return True
+    if s in ("false", "f", "no", "n", "0", ""):
+        return False
+    return default
 
 
 def has_parameters(obj):
