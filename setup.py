@@ -1,10 +1,13 @@
-"""Packaging (parity target: reference setup.py:1-254 — minus the CUDA
-extension build matrix, which has no TPU analogue: the Pallas kernels
-compile at trace time via XLA/Mosaic, so the wheel is pure python)."""
+"""Packaging (parity target: reference setup.py:1-254).  The reference's
+CUDA extension build matrix has no TPU analogue — the Pallas kernels
+compile at trace time via XLA/Mosaic — but the native data tier does:
+``csrc/record_reader.c`` builds a small OPTIONAL C extension with
+GIL-releasing record-store IO (the wheel stays installable without a
+compiler; every caller falls back to the mmap path)."""
 
 import os
 
-from setuptools import find_packages, setup
+from setuptools import Extension, find_packages, setup
 
 
 def read_version():
@@ -35,6 +38,13 @@ setup(
         "data": ["lmdb", "tokenizers"],
         "test": ["pytest", "torch"],
     },
+    ext_modules=[
+        Extension(
+            "unicore_tpu_native",
+            sources=["csrc/record_reader.c"],
+            optional=True,  # build failure must never block install
+        ),
+    ],
     entry_points={
         "console_scripts": [
             "unicore-train = unicore_tpu_cli.train:cli_main",

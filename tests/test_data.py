@@ -312,3 +312,22 @@ def test_process_worker_resume_sees_current_epoch():
         assert all(300 <= v < 400 for v in np.asarray(batch).ravel()), batch
     finally:
         iterators.set_worker_impl("thread")
+
+
+def test_record_dataset_fallback_without_native(tmp_path, monkeypatch):
+    """The mmap fallback branch of read_batch/prefetch (native extension
+    absent) — always runs, independent of whether the extension is built."""
+    from unicore_tpu.data import IndexedRecordWriter
+    from unicore_tpu.data import indexed_dataset as mod
+
+    path = str(tmp_path / "d.rec")
+    with IndexedRecordWriter(path) as w:
+        for i in range(6):
+            w.write({"v": np.array([i, i + 1])})
+    monkeypatch.setattr(mod, "_native", None)
+    ds = mod.IndexedRecordDataset(path)
+    assert not ds.supports_prefetch
+    ds.prefetch(range(6))  # no-op, must not raise
+    got = ds.read_batch(np.array([4, 0]))
+    np.testing.assert_array_equal(got[0]["v"], [4, 5])
+    np.testing.assert_array_equal(got[1]["v"], [0, 1])

@@ -8,6 +8,7 @@ matching the reference's LMDB record semantics
 thread-safe; the per-item LRU cache mirrors the reference.
 """
 
+import logging
 import os
 import pickle
 from functools import lru_cache
@@ -16,7 +17,16 @@ import numpy as np
 
 from .unicore_dataset import UnicoreDataset
 
+logger = logging.getLogger(__name__)
+
 _MAGIC = b"UTPUREC1"
+
+try:
+    # optional C extension (csrc/record_reader.c): GIL-releasing span
+    # reads + page-cache readahead; absent -> pure mmap path
+    import unicore_tpu_native as _native
+except ImportError:  # pragma: no cover - environment without the ext
+    _native = None
 
 
 class IndexedRecordWriter:
@@ -68,6 +78,52 @@ class IndexedRecordDataset(UnicoreDataset):
     def __getitem__(self, idx):
         start, end = self._offsets[idx], self._offsets[idx + 1]
         return pickle.loads(self._data()[start:end].tobytes())
+
+    def read_batch(self, indices):
+        """Decode several records in one call.  With the native extension
+        the span reads happen via pread with the GIL released; without
+        it, the mmap path.  Public API for direct consumers of the store
+        — the batch loader's own native path is ``prefetch`` (fanned down
+        per batch through any wrapper stack by ``_EpochStream._load``)."""
+        if _native is not None:
+            starts = [int(self._offsets[i]) for i in indices]
+            lens = [
+                int(self._offsets[i + 1] - self._offsets[i]) for i in indices
+            ]
+            return [
+                pickle.loads(b)
+                for b in _native.read_spans(self.path, starts, lens)
+            ]
+        return [self[int(i)] for i in indices]
+
+    @property
+    def supports_prefetch(self):
+        return _native is not None
+
+    # epoch-open readahead is synchronous: cap the warmed volume so a
+    # huge dataset can't stall the epoch start or evict the page cache
+    PREFETCH_BYTE_CAP = 1 << 30
+
+    def prefetch(self, indices):
+        """Warm the page cache for this epoch's spans (native readahead:
+        no Python-side memory held, the kernel just has the bytes hot by
+        the time the batch loaders fault them in)."""
+        if _native is None or len(indices) == 0:
+            return
+        idx = np.unique(np.asarray(list(indices), dtype=np.int64))
+        starts = self._offsets[idx]
+        lens = self._offsets[idx + 1] - starts
+        keep = np.cumsum(lens) <= self.PREFETCH_BYTE_CAP
+        if not keep.all():
+            logger.info(
+                "readahead capped: warming %d of %d bytes for %s",
+                int(lens[keep].sum()), int(lens.sum()), self.path,
+            )
+        starts, lens = starts[keep], lens[keep]
+        touched = _native.readahead(
+            self.path, [int(s) for s in starts], [int(l) for l in lens]
+        )
+        logger.debug("readahead warmed %d bytes of %s", touched, self.path)
 
     def __getstate__(self):
         state = self.__dict__.copy()

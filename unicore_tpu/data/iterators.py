@@ -204,6 +204,12 @@ class _EpochStream:
     def _load(self, indices):
         if len(indices) == 0:
             return {}  # lockstep dummy; trainer assigns it zero weight
+        # per-batch prefetch: wrapper stacks fan this down to the record
+        # store, whose native readahead does the disk IO with the GIL
+        # released — the per-item __getitem__ loop below then reads warm
+        # pages, so thread workers stop serializing on IO
+        if getattr(self.dataset, "supports_prefetch", False):
+            self.dataset.prefetch(indices)
         return self.collate_fn([self.dataset[int(i)] for i in indices])
 
     def _produce(self):
@@ -379,8 +385,9 @@ class EpochBatchIterator:
         plan = self._shard_plan(epoch, shuffle)
         if offset > 0 and offset >= len(plan):
             return None
-        if getattr(self.dataset, "supports_prefetch", False):
-            self.dataset.prefetch([i for b in plan for i in b])
+        # prefetch happens PER BATCH in _EpochStream._load (an epoch-wide
+        # warm here would read the whole shard by file offset — wrong
+        # order under shuffling, and stalls the epoch open)
         return _EpochStream(
             self.dataset, self.collate_fn, plan, offset=offset,
             num_workers=self.num_workers, buffer_size=self.buffer_size,
