@@ -13,8 +13,9 @@
  *   readahead(path, starts, lengths) -> int (bytes touched)
  *       Page-cache warmup (posix_fadvise WILLNEED per span, then a
  *       bounded sequential pread sweep), GIL released.  Used by the
- *       dataset's `prefetch` hook at epoch start: no Python-side memory
- *       is held, the kernel just has the epoch's spans hot.
+ *       dataset's `prefetch` hook, called per batch by the loader: no
+ *       Python-side memory is held, the kernel just has the batch's
+ *       spans hot before the collate loop reads them.
  *
  * Built as an OPTIONAL extension (setup.py: optional=True) — every
  * caller falls back to the mmap path when the module is absent.
@@ -86,6 +87,15 @@ static PyObject *py_read_spans(PyObject *self, PyObject *args) {
         free(lens);
         PyErr_SetString(PyExc_ValueError, "starts/lengths length mismatch");
         return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (starts[i] < 0 || lens[i] < 0) {
+            free(starts);
+            free(lens);
+            PyErr_SetString(PyExc_ValueError,
+                            "negative span (corrupt offset index?)");
+            return NULL;
+        }
     }
 
     /* Allocate result bytes objects with the GIL held... */
@@ -172,6 +182,15 @@ static PyObject *py_readahead(PyObject *self, PyObject *args) {
         free(lens);
         PyErr_SetString(PyExc_ValueError, "starts/lengths length mismatch");
         return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (starts[i] < 0 || lens[i] < 0) {
+            free(starts);
+            free(lens);
+            PyErr_SetString(PyExc_ValueError,
+                            "negative span (corrupt offset index?)");
+            return NULL;
+        }
     }
 
     int64_t touched = 0;

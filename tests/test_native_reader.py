@@ -66,3 +66,31 @@ def test_read_spans_errors():
         native.read_spans("/nonexistent/file.rec", [0], [4])
     with pytest.raises(ValueError):
         native.read_spans("/tmp", [0, 1], [4])
+
+
+def test_read_spans_rejects_negative_spans(store):
+    path, _ = store
+    with pytest.raises(ValueError, match="negative span"):
+        native.read_spans(path, [-1], [4])
+    with pytest.raises(ValueError, match="negative span"):
+        native.readahead(path, [0], [-4])
+
+
+def test_prefetch_dedupes_consecutive_duplicate_calls(store, monkeypatch):
+    """Nested stacks fan one batch's prefetch to several leaves sharing
+    this store; the second identical call must not re-read the bytes."""
+    path, _ = store
+    ds = IndexedRecordDataset(path)
+    calls = []
+    monkeypatch.setattr(
+        "unicore_tpu.data.indexed_dataset._native",
+        type("N", (), {
+            "readahead": staticmethod(
+                lambda p, s, l: calls.append(len(s)) or sum(l)
+            ),
+        }),
+    )
+    ds.prefetch([1, 2, 3])
+    ds.prefetch([1, 2, 3])  # duplicate -> dropped
+    ds.prefetch([4, 5])
+    assert len(calls) == 2

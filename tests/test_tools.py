@@ -1,0 +1,106 @@
+"""Tools surface: torch-checkpoint converter and the --profile trace
+capture (both claimed in docs, previously untested)."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_convert_torch_checkpoint(tmp_path):
+    torch = pytest.importorskip("torch")
+    from unicore_tpu.tools.convert_torch_checkpoint import convert
+
+    ckpt = {
+        "model": {
+            "encoder.layers.0.fc1.weight": torch.randn(8, 4),
+            "encoder.embed.weight": torch.arange(12).reshape(6, 2),
+        },
+        "extra_state": {"train_iterator": {"epoch": 3}, "val_loss": 1.5},
+    }
+    src = str(tmp_path / "ref.pt")
+    dst = str(tmp_path / "out.pt")
+    torch.save(ckpt, src)
+
+    mapping = {"encoder.embed.weight": "params/embed_tokens/embedding"}
+    convert(src, dst, mapping)
+
+    with open(dst, "rb") as f:
+        out = pickle.load(f)
+    assert out["format"].startswith("unicore_tpu/torch-import")
+    flat = out["torch_model"]
+    assert "params/embed_tokens/embedding" in flat  # renamed
+    np.testing.assert_array_equal(
+        flat["params/embed_tokens/embedding"],
+        np.arange(12).reshape(6, 2),
+    )
+    np.testing.assert_allclose(
+        flat["encoder.layers.0.fc1.weight"],
+        ckpt["model"]["encoder.layers.0.fc1.weight"].numpy(),
+    )
+    # non-scalar extra_state entries are dropped, scalars survive
+    assert out["extra_state"] == {"val_loss": 1.5}
+
+
+def test_convert_cli_entry(tmp_path):
+    torch = pytest.importorskip("torch")
+    src = str(tmp_path / "ref.pt")
+    dst = str(tmp_path / "out.pt")
+    torch.save({"model": {"w": torch.zeros(2)}}, src)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.tools.convert_torch_checkpoint",
+         src, dst],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert os.path.exists(dst)
+
+
+def test_profile_flag_captures_trace(tmp_path):
+    """--profile wraps the run in jax.profiler.trace: an xplane/perfetto
+    trace must exist under save_dir/jax_trace after a short CLI run."""
+    from unicore_tpu.data import IndexedRecordWriter
+
+    data_dir = str(tmp_path / "data")
+    os.makedirs(data_dir)
+    rng = np.random.RandomState(0)
+    words = ["w%d" % i for i in range(20)]
+    with open(os.path.join(data_dir, "dict.txt"), "w") as f:
+        for w in words:
+            f.write(f"{w} 1\n")
+    for split in ("train", "valid"):
+        with IndexedRecordWriter(os.path.join(data_dir, split + ".rec")) as w:
+            for _ in range(16):
+                w.write(list(rng.choice(words, size=10)))
+
+    save_dir = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu_cli.train", data_dir,
+         "--user-dir", os.path.join(REPO, "examples", "bert"),
+         "--task", "bert", "--loss", "masked_lm", "--arch", "bert_base",
+         "--encoder-layers", "1", "--encoder-embed-dim", "32",
+         "--encoder-ffn-embed-dim", "64", "--encoder-attention-heads", "2",
+         "--max-seq-len", "16", "--pre-tokenized", "--batch-size", "8",
+         "--optimizer", "adam", "--lr", "1e-3", "--lr-scheduler", "fixed",
+         "--max-update", "3", "--log-format", "simple", "--profile",
+         "--save-dir", save_dir, "--required-batch-size-multiple", "1",
+         "--num-workers", "0", "--cpu"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    trace_dir = os.path.join(save_dir, "jax_trace")
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found += [f for f in files if f.endswith((".xplane.pb",
+                                                  ".trace.json.gz"))]
+    assert found, f"no trace files under {trace_dir}"

@@ -100,16 +100,23 @@ class IndexedRecordDataset(UnicoreDataset):
     def supports_prefetch(self):
         return _native is not None
 
-    # epoch-open readahead is synchronous: cap the warmed volume so a
-    # huge dataset can't stall the epoch start or evict the page cache
+    # readahead is synchronous: cap a single call's warmed volume so a
+    # direct whole-shard prefetch can't stall the caller or evict the
+    # page cache (the loader's per-batch calls are far below this)
     PREFETCH_BYTE_CAP = 1 << 30
 
     def prefetch(self, indices):
-        """Warm the page cache for this epoch's spans (native readahead:
-        no Python-side memory held, the kernel just has the bytes hot by
-        the time the batch loaders fault them in)."""
+        """Warm the page cache for these records' spans (native
+        readahead: no Python-side memory held, the kernel has the bytes
+        hot by the time readers fault them in).  Consecutive duplicate
+        calls are dropped — nested dataset stacks fan one batch's
+        prefetch to several leaves that bottom out at this same store."""
         if _native is None or len(indices) == 0:
             return
+        key = tuple(int(i) for i in indices)
+        if key == getattr(self, "_last_prefetch_key", None):
+            return
+        self._last_prefetch_key = key
         idx = np.unique(np.asarray(list(indices), dtype=np.int64))
         starts = self._offsets[idx]
         lens = self._offsets[idx + 1] - starts

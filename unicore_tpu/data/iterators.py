@@ -207,8 +207,14 @@ class _EpochStream:
         # per-batch prefetch: wrapper stacks fan this down to the record
         # store, whose native readahead does the disk IO with the GIL
         # released — the per-item __getitem__ loop below then reads warm
-        # pages, so thread workers stop serializing on IO
-        if getattr(self.dataset, "supports_prefetch", False):
+        # pages, so thread workers stop serializing on IO.  Only when
+        # thread workers are actually in use: without them there is no
+        # GIL contention to relieve and the sweep is pure overhead.
+        if (
+            self.num_workers > 0
+            and worker_impl() == "thread"
+            and getattr(self.dataset, "supports_prefetch", False)
+        ):
             self.dataset.prefetch(indices)
         return self.collate_fn([self.dataset[int(i)] for i in indices])
 
