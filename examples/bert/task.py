@@ -68,7 +68,28 @@ class BertTask(UnicoreTask):
         dict_path = os.path.join(self.args.data, "dict.txt")
 
         dataset = best_record_dataset(split_path)
-        if getattr(self.args, "pre_tokenized", False):
+        pre_tokenized = getattr(self.args, "pre_tokenized", False)
+        if not pre_tokenized and len(dataset):
+            first = dataset[0]
+            # preprocess.py stores token-string LISTS by default; without
+            # this check a missing --pre-tokenized surfaces as an
+            # AttributeError deep inside a data-worker thread.  Only the
+            # unambiguous case flips (a sequence of strings) — anything
+            # else (e.g. already-numericalized int arrays) still reaches
+            # the tokenizer and fails loudly rather than silently mapping
+            # every id's str() to unk.
+            if (
+                isinstance(first, (list, tuple))
+                and first
+                and all(isinstance(t, str) for t in first)
+            ):
+                logger.warning(
+                    "%s records are token lists, not raw text — assuming "
+                    "--pre-tokenized (pass it explicitly to silence this)",
+                    split_path,
+                )
+                pre_tokenized = True
+        if pre_tokenized:
             dataset = TokenizeDataset(
                 dataset, self.dictionary, max_seq_len=self.args.max_seq_len
             )

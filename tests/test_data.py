@@ -131,6 +131,43 @@ def test_nested_dictionary_dataset():
     assert batch["ntokens"] == 4
 
 
+def test_nested_prefetch_dedupes_shared_leaf_store():
+    """One batch's prefetch fan-out must hit each LEAF STORE once, even
+    when several nested leaves (e.g. mask-tokens src/tgt twins) wrap the
+    same store — and stores that are genuinely different must all be hit.
+    Per-call dedup via ``prefetch_target``: a cross-call key on the store
+    is defeated by worker threads interleaving different batches."""
+
+    class Store(ListDataset):
+        supports_prefetch = True
+
+        def __init__(self, items):
+            super().__init__(items)
+            self.calls = []
+
+        def prefetch(self, indices):
+            self.calls.append(list(indices))
+
+    store = Store([np.array([1, 2]), np.array([3, 4])])
+    other = Store([np.array([5, 6]), np.array([7, 8])])
+    ds = NestedDictionaryDataset(
+        {
+            "net_input": {
+                "src_tokens": RightPadDataset(store, pad_idx=0,
+                                              pad_to_multiple=1)
+            },
+            "target": RightPadDataset(store, pad_idx=0, pad_to_multiple=1),
+            "aux": other,
+        }
+    )
+    assert ds.supports_prefetch
+    ds.prefetch([0, 1])
+    assert store.calls == [[0, 1]]  # shared store: exactly once
+    assert other.calls == [[0, 1]]  # distinct store: still reached
+    ds.prefetch([1])  # a different batch is a fresh fan-out
+    assert store.calls == [[0, 1], [1]]
+
+
 def test_token_wrappers():
     base = ListDataset([np.array([5, 6], dtype=np.int64)])
     ds = AppendTokenDataset(PrependTokenDataset(base, 0), 2)

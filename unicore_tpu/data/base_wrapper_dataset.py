@@ -40,6 +40,10 @@ class BaseWrapperDataset(UnicoreDataset):
         return getattr(self.dataset, "supports_prefetch", False)
 
     @property
+    def prefetch_target(self):
+        return getattr(self.dataset, "prefetch_target", self.dataset)
+
+    @property
     def can_reuse_epoch_itr_across_epochs(self):
         return self.dataset.can_reuse_epoch_itr_across_epochs
 

@@ -108,15 +108,12 @@ class IndexedRecordDataset(UnicoreDataset):
     def prefetch(self, indices):
         """Warm the page cache for these records' spans (native
         readahead: no Python-side memory held, the kernel has the bytes
-        hot by the time readers fault them in).  Consecutive duplicate
-        calls are dropped — nested dataset stacks fan one batch's
-        prefetch to several leaves that bottom out at this same store."""
+        hot by the time readers fault them in).  Fan-out callers dedupe
+        stacks whose leaves share this store via ``prefetch_target``
+        (per-call, thread-safe — concurrent worker threads interleave
+        batches, so cross-call state here could not be trusted)."""
         if _native is None or len(indices) == 0:
             return
-        key = tuple(int(i) for i in indices)
-        if key == getattr(self, "_last_prefetch_key", None):
-            return
-        self._last_prefetch_key = key
         idx = np.unique(np.asarray(list(indices), dtype=np.int64))
         starts = self._offsets[idx]
         lens = self._offsets[idx + 1] - starts

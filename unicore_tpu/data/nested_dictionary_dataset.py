@@ -117,6 +117,18 @@ class NestedDictionaryDataset(UnicoreDataset):
         return any(getattr(ds, "supports_prefetch", False) for _, ds in self.leaves)
 
     def prefetch(self, indices):
+        # dedupe by the LEAF STORE actually performing the prefetch:
+        # several leaves (e.g. the mask-tokens src/tgt twins) bottom out
+        # at one record store, and re-reading the same spans would double
+        # the readahead IO.  Per-call local state — unlike a cross-call
+        # "last indices" key on the store itself, this cannot be defeated
+        # by concurrent worker threads interleaving different batches.
+        seen = set()
         for _, ds in self.leaves:
-            if getattr(ds, "supports_prefetch", False):
-                ds.prefetch(indices)
+            if not getattr(ds, "supports_prefetch", False):
+                continue
+            target = id(getattr(ds, "prefetch_target", ds))
+            if target in seen:
+                continue
+            seen.add(target)
+            ds.prefetch(indices)

@@ -88,6 +88,14 @@ class UnicoreDataset(EpochListening):
     def prefetch(self, indices):
         raise NotImplementedError
 
+    @property
+    def prefetch_target(self):
+        """Identity of the object whose ``prefetch`` actually runs —
+        wrapper stacks forward this to their leaf store, so fan-out
+        callers (``NestedDictionaryDataset.prefetch``) can drop duplicate
+        calls that bottom out at the same store."""
+        return self
+
     def attr(self, attr, index):
         """Per-sample attribute lookup; defaults to a dataset-level attr."""
         return getattr(self, attr, None)

@@ -24,4 +24,5 @@ from .triangle_attention import (  # noqa: F401
     EvoformerPairBlock,
     PairTransition,
     TriangleAttention,
+    TriangleMultiplication,
 )
