@@ -205,6 +205,18 @@ def _timed(fn, *args, iters=10, min_window_s=0.08):
     return best
 
 
+def _interleaved_ratio(measure_fast, measure_slow):
+    """slow/fast time ratio, measured F S S F with the best (min) time
+    taken per side: the relay link's throughput drifts over minutes, so a
+    ratio whose two sides are measured back-to-back in a fixed order
+    swings ±30% run to run.  Every A/B comparison in this file goes
+    through this one protocol."""
+    t_f = measure_fast()
+    t_s = min(measure_slow(), measure_slow())
+    t_f = min(t_f, measure_fast())
+    return t_s / t_f
+
+
 def _microbench(out):
     """Pallas-vs-jnp-reference speedups on the chip (the analogue of the
     reference's fused-vs-eager CUDA kernel comparison, BASELINE.md).
@@ -222,19 +234,20 @@ def _microbench(out):
     rng = np.random.RandomState(0)
 
     def compare(make_fn, *args):
-        """PRRP-ordered best-of-two per backend: the relay link's
-        throughput drifts over minutes, so a ratio whose two sides are
-        measured back-to-back in a fixed order swings ±30% run to run."""
+        """Backend speedup via the shared interleave protocol; two
+        separate jits so each traces under its own backend."""
         fp = jax.jit(make_fn())
-        fr = jax.jit(make_fn())  # separate jit: re-traces per backend
-        with kernel_backend("pallas"):
-            t_p = _timed(fp, *args)
-        with kernel_backend("reference"):
-            t_r = _timed(fr, *args)
-            t_r = min(t_r, _timed(fr, *args))
-        with kernel_backend("pallas"):
-            t_p = min(t_p, _timed(fp, *args))
-        return t_r / t_p
+        fr = jax.jit(make_fn())
+
+        def run_p():
+            with kernel_backend("pallas"):
+                return _timed(fp, *args)
+
+        def run_r():
+            with kernel_backend("reference"):
+                return _timed(fr, *args)
+
+        return _interleaved_ratio(run_p, run_r)
 
     # fused softmax_dropout (bias+mask+softmax), fwd+bwd, BERT shape
     x = jnp.asarray(rng.randn(32, 12, 512, 512), jnp.bfloat16)
@@ -280,10 +293,9 @@ def _microbench(out):
 
     fl = jax.jit(jax.grad(fl_loss))
     mat = jax.jit(jax.grad(mat_loss))
-    t_p = _timed(fl, q)
-    t_r = min(_timed(mat, q), _timed(mat, q))
-    t_p = min(t_p, _timed(fl, q))
-    out["flash_attention_t2048_speedup"] = round(t_r / t_p, 3)
+    out["flash_attention_t2048_speedup"] = round(
+        _interleaved_ratio(lambda: _timed(fl, q), lambda: _timed(mat, q)), 3
+    )
 
     # fused vs eager AdamW (BASELINE.md "fused-vs-eager speedup"): the
     # framework's one-jit whole-tree update (the analogue of the
@@ -305,8 +317,6 @@ def _microbench(out):
              for k in params}
     state = opt.init(params)
     fused = jax.jit(lambda g, s, p: opt.update(g, s, p, lr=1e-4))
-    t_f = _timed(fused, grads, state, params)
-
     leaf_upd = jax.jit(
         lambda g, s, p: opt.update({"x": g}, s, {"x": p}, lr=1e-4)
     )
@@ -317,10 +327,12 @@ def _microbench(out):
             leaf_upd(grads[k], states[k], params[k]) for k in params
         ]
 
-    t_e = min(_timed(eager, grads, leaf_states, params),
-              _timed(eager, grads, leaf_states, params))
-    t_f = min(t_f, _timed(fused, grads, state, params))
-    out["adam_fused_vs_eager_speedup"] = round(t_e / t_f, 3)
+    out["adam_fused_vs_eager_speedup"] = round(
+        _interleaved_ratio(
+            lambda: _timed(fused, grads, state, params),
+            lambda: _timed(eager, grads, leaf_states, params),
+        ), 3,
+    )
 
 
 def _e2e_backend_speedup(cfg):
@@ -333,19 +345,22 @@ def _e2e_backend_speedup(cfg):
 
     small = dict(cfg, steps=5, warmup=2)
 
-    # ABBA order, best-of-two per backend: back-to-back runs in one
-    # process drift upward as the allocator/relay warm (measured 165 ->
-    # 193 samples/s for the SAME backend), so a fixed auto-then-reference
-    # order biases the ratio by up to ~30%.  The compiled steps are built
-    # once per backend (trace-time backend selection) and reused, so the
-    # repeats cost steps, not recompiles.
+    # the compiled steps are built once per backend (trace-time backend
+    # selection) and reused, so the interleave's repeats cost steps, not
+    # recompiles.  _interleaved_ratio wants TIMES (slow/fast); throughput
+    # inverts, so feed it 1/sps.
     measure_auto = _prepare_run(small)
-    auto_sps = measure_auto()[0]
     with kernel_backend("reference"):
         measure_ref = _prepare_run(small)
-        ref_sps = max(measure_ref()[0], measure_ref()[0])
-    auto_sps = max(auto_sps, measure_auto()[0])
-    return round(auto_sps / ref_sps, 3)
+
+    def t_auto():
+        return 1.0 / measure_auto()[0]
+
+    def t_ref():
+        with kernel_backend("reference"):
+            return 1.0 / measure_ref()[0]
+
+    return round(_interleaved_ratio(t_auto, t_ref), 3)
 
 
 def main():

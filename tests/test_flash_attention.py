@@ -117,6 +117,43 @@ def test_flash_dropout_grads_finite(rng, qkv):
     assert bool(jnp.all(jnp.isfinite(g)))
 
 
+def test_flash_multiblock_grad_parity(rng, qkv, monkeypatch):
+    """Pin small blocks so T=256 spans a 2x2 block grid: covers the
+    cross-k-block online-softmax rescale in the forward and the
+    scratch-accumulating three-pass backward (dq, dkv, dbias) — the
+    long-context path.  (At the natural block pick T=256 is single-block
+    and takes the fused backward, which the other tests cover.)"""
+    import unicore_tpu.ops.pallas.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_pick_blocks", lambda tq, tk: (128, 128))
+    q, k, v = qkv
+    bias = jnp.asarray(rng.randn(1, H, T, T).astype(np.float32))
+    pad = np.zeros((B, T), dtype=np.int32)
+    pad[:, -32:] = 1
+    pad = jnp.asarray(pad)
+
+    out = flash_attention(q, k, v, bias=bias, key_padding_mask=pad,
+                          is_training=False)
+    ref = ref_attn(q, k, v, bias=bias, pad=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **FWD_TOL)
+
+    def lf(q, k, v, bias):
+        return jnp.sum(
+            flash_attention(q, k, v, bias=bias, key_padding_mask=pad,
+                            is_training=False) ** 2
+        )
+
+    def lr(q, k, v, bias):
+        return jnp.sum(ref_attn(q, k, v, bias=bias, pad=pad) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for name, a, b in zip("q k v bias".split(), g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), err_msg=name, **GRAD_TOL
+        )
+
+
 def test_eligibility_rules():
     assert eligible((2, 4, 256, 64), (2, 4, 256, 64), None)
     assert eligible((2, 4, 256, 64), (2, 4, 256, 64), (1, 4, 256, 256))

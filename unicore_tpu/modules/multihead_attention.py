@@ -53,11 +53,17 @@ def _flash_ok(q, k, bias, has_pad, dropout_on):
     ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
     if not fa.eligible(qs, ks, None if bias is None else bias.shape):
         return False
-    # measured on v5e (BERT-base, T=512): with a TRAINABLE bias the flash
-    # backward pays an extra full dbias recompute pass and loses to the
-    # materialized einsum + fused-softmax path (~108 vs ~98 samples/s);
-    # flash wins once [B,H,Tq,Tk] is HBM-prohibitive.  Auto mode picks by
-    # sequence length; a forced "pallas" backend still takes flash.
+    # measured on v5e (BERT-base, T=512, trainable [1,H,T,T] bias,
+    # dropout): the single-block fused backward makes flash 1.6x faster
+    # than the materialized einsum + fused-softmax path as an ISOLATED op
+    # (5.7 vs 9.1 ms fwd+bwd), but END-TO-END in the 12-layer model the
+    # two tie (best-of-4 interleaved: 192.8 vs 193.7 samples/s) — the
+    # [B,T,H,D]<->[B,H,T,D] transposes around the kernel and the lost
+    # fusion with neighbouring ops eat the win.  Below T=1024 a trainable
+    # bias therefore keeps the materialized path (and in the multi-block
+    # regime the separate dbias recompute sweep makes flash strictly
+    # worse); flash wins once [B,H,Tq,Tk] is HBM-prohibitive.  A forced
+    # "pallas" backend always takes flash.
     from unicore_tpu.ops.backend import get_kernel_backend
 
     if (

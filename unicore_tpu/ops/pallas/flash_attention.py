@@ -246,6 +246,81 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, *rest, has_bias, has_pad, scale, causal,
+                      dropout_prob, block_q, block_k, n_h, n_q, n_k, n_b,
+                      want_dbias):
+    """Single-pass backward for the single-k-block regime (n_q == n_k == 1,
+    i.e. the whole sequence fits one score block): grid (H, B) with batch
+    innermost.  The scores are recomputed ONCE and dq/dk/dv are written
+    directly (no cross-block accumulation exists when there is only one
+    block), while dbias accumulates over the batch in scratch — folding
+    the separate dbias pass (a full extra recompute sweep) into the same
+    kernel.  This is what makes flash a net win at BERT's T=512 with a
+    trainable rel-pos bias; the three-pass form only pays off once the
+    sequence spans multiple blocks."""
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    if want_dbias:
+        dq_ref, dk_ref, dv_ref, dbias_ref, db_scr = refs
+    else:
+        dq_ref, dk_ref, dv_ref = refs
+        dbias_ref = db_scr = None
+    h, b = pl.program_id(0), pl.program_id(1)
+    i = j = 0
+
+    if db_scr is not None:
+        @pl.when(b == 0)
+        def _():
+            db_scr[...] = jnp.zeros_like(db_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)
+
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        p_drop = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
+    else:
+        keep = None
+        p_drop = p
+
+    dv_ref[0, 0] = jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if keep is not None:
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_prob)), 0.0)
+    ds = p * (dp - delta)
+    dq_ref[0, 0] = (jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale).astype(dq_ref.dtype)
+    dk_ref[0, 0] = (jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale).astype(dk_ref.dtype)
+    if db_scr is not None:
+        db_scr[...] += ds
+
+        @pl.when(b == n_b - 1)
+        def _():
+            dbias_ref[0] = db_scr[...].astype(dbias_ref.dtype)
+
+
 def _dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                   *rest, has_bias, has_pad, scale, causal, dropout_prob,
                   block_q, block_k, n_h, n_q, n_k, n_b):
@@ -303,7 +378,7 @@ def _pick_blocks(tq, tk):
 
     bq = pick(tq, (512, 256, 128))
     budget = (1 << 20) // bq  # score-block element budget
-    bk = pick(tk, tuple(c for c in (2048, 1024, 512, 128) if c <= budget))
+    bk = pick(tk, tuple(c for c in (2048, 1024, 512, 256, 128) if c <= budget))
     return bq, bk
 
 
@@ -471,6 +546,12 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B,H,Tq,1]
 
+    if n_q == 1 and n_k == 1:
+        return _flash_bwd_fused(
+            q, k, v, bias, pad, seed, lse, delta, g, dropout_prob, causal,
+            scale, block_q, block_k,
+        )
+
     common_in = [
         _SEED_SPEC, _q_spec(block_q, d), _kv_spec(block_k, d),
         _kv_spec(block_k, d), _q_spec(block_q, d), _lse_spec(block_q),
@@ -603,14 +684,90 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
                                      "arbitrary"),
             ),
         )(*db_args)
-        # reduce to the bias's broadcast shape ([1, bH, bQ, tk])
-        db = dbias_full[None]  # [1, H, Tq, Tk]
-        if bH == 1:
-            db = jnp.sum(db, axis=1, keepdims=True)
-        if bQ == 1:
-            db = jnp.sum(db, axis=2, keepdims=True)
-        dbias = db.astype(bias.dtype)
+        dbias = _reduce_dbias(dbias_full, bias)
 
+    return dq, dk, dv, dbias, None, None
+
+
+def _reduce_dbias(dbias_full, bias):
+    """Reduce the kernel's [H, Tq, Tk] batch-summed dbias to the bias's
+    broadcast shape [1, bH, bQ, Tk] (shared by the multi-block and fused
+    backward paths)."""
+    _, bH, bQ, _ = bias.shape
+    db = dbias_full[None]  # [1, H, Tq, Tk]
+    if bH == 1:
+        db = jnp.sum(db, axis=1, keepdims=True)
+    if bQ == 1:
+        db = jnp.sum(db, axis=2, keepdims=True)
+    return db.astype(bias.dtype)
+
+
+def _flash_bwd_fused(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
+                     causal, scale, block_q, block_k):
+    """dq/dk/dv(/dbias) in one kernel over grid (H, B), batch innermost."""
+    bsz, heads, tq, tk, d = q.shape[0], q.shape[1], q.shape[2], k.shape[2], q.shape[3]
+    want_dbias = bias is not None
+
+    def spec4(blk_t):
+        return pl.BlockSpec((1, 1, blk_t, d), lambda h, b: (b, h, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda h, b: (b, h, 0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [_SEED_SPEC, spec4(block_q), spec4(block_k), spec4(block_k),
+                spec4(block_q), lse_spec, lse_spec]
+    args = [seed, q, k, v, g, lse, delta]
+    if bias is not None:
+        bB, bH, bQ, bK = bias.shape
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bQ, block_k),
+            lambda h, b: (0, 0 if bH == 1 else h, 0, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        args.append(bias)
+    if pad is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda h, b: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        args.append(pad)
+
+    out_specs = [spec4(block_q), spec4(block_k), spec4(block_k)]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    scratch = []
+    if want_dbias:
+        out_specs.append(pl.BlockSpec(
+            (1, block_q, block_k), lambda h, b: (h, 0, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        out_shape.append(
+            jax.ShapeDtypeStruct((heads, tq, tk), jnp.float32)
+        )
+        scratch.append(pltpu.VMEM((block_q, block_k), jnp.float32))
+
+    results = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, has_bias=bias is not None,
+            has_pad=pad is not None, scale=scale, causal=causal,
+            dropout_prob=dropout_prob, block_q=block_q, block_k=block_k,
+            n_h=heads, n_q=1, n_k=1, n_b=bsz, want_dbias=want_dbias,
+        ),
+        grid=(heads, bsz),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(*args)
+    dq, dk, dv = results[0], results[1], results[2]
+    dbias = _reduce_dbias(results[3], bias) if want_dbias else None
     return dq, dk, dv, dbias, None, None
 
 
