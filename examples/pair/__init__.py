@@ -1,0 +1,10 @@
+"""Evoformer pair-stack example plugin (``--user-dir examples/pair``).
+
+The Uni-Mol / Uni-Fold workload shape: a square pair representation
+``[B, N, N, C]`` refined by triangle multiplicative updates and triangle
+attention (the 5-D broadcast softmax contracts), trained here on a
+synthetic distance-regression task.  Third model family next to
+``examples/bert`` (encoder MLM) and ``examples/lm`` (causal decoder).
+"""
+
+from . import loss, model, task  # noqa: F401 — trigger @register_* decorators

@@ -1,0 +1,58 @@
+"""Masked per-pair MSE loss, registered from the plugin.
+
+``sample_size`` is the count of VALID pairs (mask-weighted), so the
+reported loss is a per-pair mean and the derived ``rmse`` is in target
+units — comparable across batch compositions.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from unicore_tpu import metrics
+from unicore_tpu.losses import UnicoreLoss, register_loss
+
+
+@register_loss("pair_mse")
+class PairMSELoss(UnicoreLoss):
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        target = sample["target"]
+        mask = sample.get("pair_mask")
+        pred = model.apply(
+            {"params": params},
+            **sample["net_input"],
+            pair_mask=mask,
+            deterministic=not is_training,
+            rngs={"dropout": rng} if (is_training and rng is not None) else None,
+        )
+        err2 = (pred.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+        if mask is not None:
+            w = mask.astype(jnp.float32)
+            loss = jnp.sum(err2 * w)
+            sample_size = jnp.sum(w)
+        else:
+            loss = jnp.sum(err2)
+            sample_size = jnp.asarray(err2.size, dtype=jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "sample_size": sample_size,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train"):
+        loss = sum(float(l.get("loss", 0)) for l in logging_outputs)
+        n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+        bsz = sum(float(l.get("bsz", 0)) for l in logging_outputs)
+        mse = loss / max(n, 1.0)
+        metrics.log_scalar("loss", mse, n, round=4)
+        metrics.log_scalar("bsz", bsz / max(len(logging_outputs), 1),
+                           priority=190, round=1)
+        metrics.log_derived(
+            "rmse", lambda m: math.sqrt(max(m["loss"].avg, 0.0))
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train):
+        return True

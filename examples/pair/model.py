@@ -1,0 +1,86 @@
+"""Evoformer pair-stack regression model.
+
+Input pair features ``[B, N, N, F]`` -> linear embed to C -> L
+``EvoformerPairBlock``s (triangle multiplicative update outgoing/incoming,
+triangle attention per-row/per-column, pair transition — the Uni-Fold
+Evoformer pattern the reference's fused softmax was shaped for,
+``/root/reference/tests/test_softmax.py:81-170``) -> LayerNorm -> scalar
+head per pair.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu.models import (
+    BaseUnicoreModel,
+    register_model,
+    register_model_architecture,
+)
+from unicore_tpu.modules import EvoformerPairBlock, bert_init
+from unicore_tpu.utils import eval_bool
+
+
+@register_model("evoformer_pair")
+class EvoformerPairModel(BaseUnicoreModel):
+    pair_layers: int = 4
+    pair_embed_dim: int = 64
+    pair_attention_heads: int = 4
+    dropout: float = 0.0
+    triangle_multiplication: bool = True
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("--pair-layers", type=int, metavar="L")
+        parser.add_argument("--pair-embed-dim", type=int, metavar="C")
+        parser.add_argument("--pair-attention-heads", type=int, metavar="A")
+        parser.add_argument("--dropout", type=float, metavar="D")
+        # NOT type=bool: bool("False") is True — eval_bool parses the text
+        parser.add_argument("--triangle-multiplication", type=eval_bool)
+
+    @classmethod
+    def build_model(cls, args, task):
+        def arg(name, default):
+            v = getattr(args, name, None)
+            return default if v is None else v
+
+        return cls(
+            pair_layers=args.pair_layers,
+            pair_embed_dim=args.pair_embed_dim,
+            pair_attention_heads=args.pair_attention_heads,
+            dropout=arg("dropout", 0.0),
+            triangle_multiplication=arg("triangle_multiplication", True),
+        )
+
+    @nn.compact
+    def __call__(self, pair, pair_mask=None, deterministic=True, **unused):
+        z = nn.Dense(self.pair_embed_dim, kernel_init=bert_init,
+                     name="embed")(pair)
+        for i in range(self.pair_layers):
+            z = EvoformerPairBlock(
+                embed_dim=self.pair_embed_dim,
+                num_heads=self.pair_attention_heads,
+                dropout=self.dropout,
+                use_triangle_multiplication=self.triangle_multiplication,
+                name=f"blocks_{i}",
+            )(z, pair_mask, deterministic)
+        z = nn.LayerNorm(name="final_norm")(z)
+        out = nn.Dense(1, kernel_init=bert_init, name="head")(z)
+        return out[..., 0]  # [B, N, N]
+
+
+@register_model_architecture("evoformer_pair", "evoformer_pair")
+def base_architecture(args):
+    args.pair_layers = getattr(args, "pair_layers", None) or 4
+    args.pair_embed_dim = getattr(args, "pair_embed_dim", None) or 64
+    args.pair_attention_heads = (
+        getattr(args, "pair_attention_heads", None) or 4
+    )
+
+
+@register_model_architecture("evoformer_pair", "evoformer_pair_base")
+def base_arch_large(args):
+    args.pair_layers = getattr(args, "pair_layers", None) or 12
+    args.pair_embed_dim = getattr(args, "pair_embed_dim", None) or 128
+    args.pair_attention_heads = (
+        getattr(args, "pair_attention_heads", None) or 8
+    )

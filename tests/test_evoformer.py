@@ -2,6 +2,7 @@
 contracts run end-to-end — module forward/backward AND a full Trainer
 step over an EvoformerPairBlock model."""
 
+import os
 from argparse import Namespace
 
 import flax.linen as nn
@@ -21,6 +22,14 @@ from unicore_tpu.tasks.unicore_task import UnicoreTask
 from unicore_tpu.trainer import Trainer
 
 B, N, C, H = 2, 8, 32, 4
+
+# On real TPU the einsum rides bf16 MXU lanes while the loop oracle
+# accumulates in fp64 — tolerance must cover the lane rounding (same
+# error model as tests/test_flash_attention.py).
+_ON_TPU = os.environ.get("UNICORE_TPU_TEST_ON_TPU", "") == "1"
+ORACLE_TOL = (
+    dict(rtol=5e-2, atol=2e-3) if _ON_TPU else dict(rtol=2e-4, atol=2e-4)
+)
 
 
 def test_triangle_attention_shapes_and_mask(rng):
@@ -84,8 +93,7 @@ def test_triangle_multiplication_contraction_oracle(rng):
             if direction == "outgoing"
             else jnp.einsum("bkic,bkjc->bijc", jnp.asarray(a), jnp.asarray(b))
         )
-        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
-                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(got), want, **ORACLE_TOL)
         out = mod.apply({"params": params}, z)
         assert out.shape == z.shape and np.isfinite(np.asarray(out)).all()
 
