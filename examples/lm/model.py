@@ -42,6 +42,7 @@ class TransformerLMModel(BaseUnicoreModel):
     max_seq_len: int = 512
     activation_fn: str = "gelu"
     post_ln: bool = False
+    rel_pos: bool = True
 
     @staticmethod
     def add_args(parser):
@@ -57,6 +58,11 @@ class TransformerLMModel(BaseUnicoreModel):
         parser.add_argument("--max-seq-len", type=int)
         # NOT type=bool: bool("False") is True — eval_bool parses the text
         parser.add_argument("--post-ln", type=eval_bool)
+        parser.add_argument("--rel-pos", type=eval_bool,
+                            help="bucketed T5 rel-pos bias; pass False for "
+                                 "long sequences — the [1,H,T,T] bias tensor "
+                                 "grows quadratically, while the bias-free "
+                                 "flash path is memory-O(T)")
 
     @classmethod
     def build_model(cls, args, task):
@@ -74,6 +80,8 @@ class TransformerLMModel(BaseUnicoreModel):
             max_seq_len=args.max_seq_len,
             activation_fn=args.activation_fn,
             post_ln=args.post_ln,
+            rel_pos=args.rel_pos if getattr(args, "rel_pos", None) is not None
+            else True,
         )
 
     @nn.compact
@@ -103,7 +111,7 @@ class TransformerLMModel(BaseUnicoreModel):
             activation_dropout=self.activation_dropout,
             max_seq_len=self.max_seq_len,
             activation_fn=self.activation_fn,
-            rel_pos=True,
+            rel_pos=self.rel_pos,
             post_ln=self.post_ln,
             auto_regressive=True,
             name="decoder",

@@ -36,6 +36,30 @@ def qkv(rng):
     return mk(), mk(), mk()
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_module_causal_under_seq_parallel(rng, mesh, qkv, impl):
+    """The decoder path under an active seq mesh axis: causal=True must
+    flow to ring/Ulysses natively (NOT as a merged -inf bias — an all--inf
+    remote score block would NaN the ring's online softmax)."""
+    from unicore_tpu import parallel
+    from unicore_tpu.modules import SelfMultiheadAttention
+
+    B, T, H, D = 2, 64, 8, 16
+    x = jnp.asarray(rng.randn(B, T, H * D).astype(np.float32))
+    attn = SelfMultiheadAttention(embed_dim=H * D, num_heads=H, dropout=0.0)
+    params = attn.init(jax.random.PRNGKey(0), x)
+    o_ref = attn.apply(params, x, causal=True)
+    parallel.enable_sequence_parallel(mesh, impl=impl)
+    try:
+        o_sp = attn.apply(params, x, causal=True)
+    finally:
+        parallel.disable_sequence_parallel()
+    assert np.isfinite(np.asarray(o_sp)).all()
+    np.testing.assert_allclose(
+        np.asarray(o_ref), np.asarray(o_sp), atol=2e-5
+    )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(rng, mesh, qkv, causal):
     q, k, v = qkv

@@ -43,7 +43,7 @@ def _padding_bias(key_padding_mask, dtype):
     )
 
 
-def _flash_ok(q, k, bias, has_pad, dropout_on):
+def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
     from unicore_tpu.ops.backend import use_pallas
     from unicore_tpu.ops.pallas import flash_attention as fa
 
@@ -79,14 +79,15 @@ def _flash_ok(q, k, bias, has_pad, dropout_on):
         q.dtype, q.shape[1], k.shape[1], q.shape[3],
         None if bias is None else bias.shape[2],
         None if bias is None else bias.dtype,
-        has_pad, False, dropout_on,
+        has_pad, causal, dropout_on,
     )
 
 
 _warned_seq_parallel_dropout = [False]
 
 
-def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias):
+def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias,
+                         causal=False):
     """Sequence-parallel attention dispatch (mesh ``seq`` axis > 1).
 
     Returns None when the shapes don't fit the active scheme (sequence or
@@ -143,25 +144,40 @@ def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias):
         if bias.shape[2] != t:  # ring shards bias rows; need full [*, *, T, S]
             bias = jnp.broadcast_to(bias, bias.shape[:2] + (t, bias.shape[3]))
 
-    batch_axes = ("data", "fsdp")
+    # only axes the mesh actually has (a bare ("seq",) mesh is legal for
+    # direct module use; shard_map rejects specs naming absent axes)
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     attend = (
         parallel.ulysses_self_attention if impl == "ulysses"
         else parallel.ring_self_attention
     )
     return attend(
         mesh, q, k, v, bias=bias, key_padding_mask=key_padding_mask,
-        scale=scaling, batch_axes=batch_axes,
+        causal=causal, scale=scaling, batch_axes=batch_axes,
     )
 
 
+def _causal_bias(tq, tk, dtype=jnp.float32):
+    """Additive [1, 1, tq, tk] causal mask built from iota compares — XLA
+    fuses it into the consumer, so no [T, T] tensor lives in HBM (a
+    materialized ``future_mask`` is 256 MB fp32 at T=8192)."""
+    import jax
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    neg_inf = jnp.asarray(float("-inf"), dtype)
+    return jnp.where(cols > rows, neg_inf, 0.0)[None, None]
+
+
 def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
-            make_rng, return_attn=False):
+            make_rng, return_attn=False, causal=False):
     """Core attention: q/k/v are [B, T, H, D].  Dispatch order: sequence
     parallelism (when the mesh's ``seq`` axis is active), then the flash
-    (blockwise) Pallas kernel on TPU when eligible — the key padding mask
-    and (batch-broadcast) bias ride into the kernel separately, so the
-    [B, H, q, k] score matrix is never materialized.  The einsum +
-    fused-softmax path is the reference semantics and the fallback."""
+    (blockwise) Pallas kernel on TPU when eligible — the key padding mask,
+    (batch-broadcast) bias, and causal masking ride into the kernel
+    separately, so neither the [B, H, q, k] score matrix nor a [T, T]
+    future-mask tensor is ever materialized.  The einsum + fused-softmax
+    path is the reference semantics and the fallback."""
     dtype = q.dtype
     rng = None
     if not deterministic and dropout > 0.0:
@@ -170,23 +186,27 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
     if not return_attn and q.shape[1] == k.shape[1]:
         sp_out = _seq_parallel_attend(
             q, k, v, scaling, dropout if not deterministic else 0.0,
-            key_padding_mask, bias,
+            key_padding_mask, bias, causal=causal,
         )
         if sp_out is not None:
             return sp_out
 
     if not return_attn and _flash_ok(
-        q, k, bias, key_padding_mask is not None, rng is not None
+        q, k, bias, key_padding_mask is not None, rng is not None,
+        causal=causal,
     ):
         from unicore_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
             q, k, v, bias=bias, key_padding_mask=key_padding_mask,
-            dropout_prob=dropout, rng=rng, is_training=not deterministic,
-            scale=scaling,
+            causal=causal, dropout_prob=dropout, rng=rng,
+            is_training=not deterministic, scale=scaling,
         )
 
     mask = _padding_bias(key_padding_mask, dtype)
+    if causal:
+        cb = _causal_bias(q.shape[1], k.shape[1])
+        bias = cb if bias is None else bias + cb
     # [B, H, q, k] scores; contraction + batched dims map directly to MXU.
     attn_weights = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k)
     if mask is not None:
@@ -221,6 +241,7 @@ class SelfMultiheadAttention(nn.Module):
         attn_bias: Optional[jnp.ndarray] = None,
         return_attn: bool = False,
         deterministic: bool = True,
+        causal: bool = False,
     ):
         bsz, tgt_len, embed_dim = query.shape
         assert embed_dim == self.embed_dim
@@ -241,6 +262,7 @@ class SelfMultiheadAttention(nn.Module):
         out = _attend(
             q, k, v, scaling, self.dropout, key_padding_mask, bias,
             deterministic, self.make_rng, return_attn=return_attn,
+            causal=causal,
         )
         if return_attn:
             o, attn_weights, probs = out

@@ -1,9 +1,15 @@
 """Transformer decoder with causal masking and cross-attention.
 
-Parity target: ``unicore/modules/transformer_decoder.py`` (future mask merged
-into the additive attention mask when ``auto_regressive``; same rel-pos bias
-and padding-merge scheme as the encoder) and
+Parity target: ``unicore/modules/transformer_decoder.py`` and
 ``transformer_decoder_layer.py`` (self-attn -> optional cross-attn -> FFN).
+Causal-semantics difference by design: the reference merges a
+materialized future mask into the additive attention mask
+(``transformer_decoder.py:19-22,106-121``); here ``auto_regressive``
+flows to the attention core as a flag so the flash kernel masks
+in-block and the materialized path builds the mask from fused iota
+compares — no [T, T] tensor in HBM (``future_mask`` below is kept for
+API parity only — nothing in the stack materializes it anymore; the
+sequence-parallel path takes ``causal=`` natively too).
 """
 
 from typing import Optional
@@ -45,6 +51,7 @@ class TransformerDecoderLayer(nn.Module):
         encoder_attn_bias: Optional[jnp.ndarray] = None,
         encoder_padding_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
+        causal: bool = False,
     ):
         act = get_activation_fn(self.activation_fn)
 
@@ -62,7 +69,7 @@ class TransformerDecoderLayer(nn.Module):
             dropout=self.attention_dropout,
             name="self_attn",
         )(x, key_padding_mask=padding_mask, attn_bias=attn_bias,
-          deterministic=deterministic)
+          deterministic=deterministic, causal=causal)
         x = drop(x, self.dropout)
         x = residual + x
         if self.post_ln:
@@ -146,9 +153,12 @@ class TransformerDecoder(nn.Module):
                 self.max_rel_pos, name="relative_attention_bias",
             )(seq_len)
             attn_mask = rel_pos_bias if attn_mask is None else attn_mask + rel_pos_bias
-        if self.auto_regressive:
-            fm = future_mask(seq_len)[None, None]
-            attn_mask = fm if attn_mask is None else attn_mask + fm
+        # causal masking is NOT merged into attn_mask: it flows to the
+        # attention core as a flag.  On the flash and sequence-parallel
+        # paths it is applied in-kernel, so no [T, T] future-mask tensor
+        # (256 MB fp32 at T=8192) ever exists; the materialized fallback
+        # still folds an iota-built mask into its bias operand (same HBM
+        # as before, short-T regime only).
 
         # padding mask intentionally NOT merged into attn_mask (see encoder)
 
@@ -169,7 +179,8 @@ class TransformerDecoder(nn.Module):
               padding_mask=padding_mask,
               encoder_attn_bias=encoder_attn_mask,
               encoder_padding_mask=encoder_padding_mask,
-              deterministic=deterministic)
+              deterministic=deterministic,
+              causal=self.auto_regressive)
 
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)

@@ -364,12 +364,16 @@ def _dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dbias_ref[0] = scr[...].astype(dbias_ref.dtype)
 
 
-def _pick_blocks(tq, tk):
+def _pick_blocks(tq, tk, bias_itemsize=0):
     """Largest divisible blocks with the fp32 score block (bq x bk) held
     to ~4MB of VMEM: measured on v5e at T=8192, (512, 2048) runs the
     fwd+bwd 1.65x faster than the original (256, 512) — bigger blocks
     amortize the online-softmax rescale and per-block overhead — while
-    (1024, 2048) exceeds the 16MB scoped-vmem stack and fails to compile."""
+    (1024, 2048) exceeds the 16MB scoped-vmem stack and fails to compile.
+    A bias adds a double-buffered (bq, bk)-shaped stream on top of the
+    fp32 score block, so its presence scales the element budget by
+    2/(2 + bias_itemsize) — 1/2 for a bf16 bias, 1/3 for fp32 (a bq=512,
+    bk=2048 fp32 bias block alone is 4MB x2 buffers)."""
     def pick(t, cands):
         for c in cands:
             if c <= t and t % c == 0:
@@ -377,7 +381,10 @@ def _pick_blocks(tq, tk):
         return t
 
     bq = pick(tq, (512, 256, 128))
-    budget = (1 << 20) // bq  # score-block element budget
+    budget_el = (1 << 20) if bias_itemsize == 0 else (
+        (1 << 20) * 2 // (2 + bias_itemsize)
+    )
+    budget = budget_el // bq  # score-block element budget
     bk = pick(tk, tuple(c for c in (2048, 1024, 512, 256, 128) if c <= budget))
     return bq, bk
 
@@ -481,16 +488,18 @@ def _lse_spec(block_q):
 _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _common(q, k, causal):
+def _common(q, k, causal, bias=None):
     bsz, heads, tq, d = q.shape
     tk = k.shape[2]
-    block_q, block_k = _pick_blocks(tq, tk)
+    block_q, block_k = _pick_blocks(
+        tq, tk, 0 if bias is None else bias.dtype.itemsize
+    )
     grid = (bsz, heads, tq // block_q, tk // block_k)
     return bsz, heads, tq, tk, d, block_q, block_k, grid
 
 
 def _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
-    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal)
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal, bias)
     in_specs = [_SEED_SPEC, _q_spec(block_q, d), _kv_spec(block_k, d),
                 _kv_spec(block_k, d)]
     args = [seed, q, k, v]
@@ -540,7 +549,7 @@ def _flash_fwd(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
 
 def _flash_bwd(dropout_prob, causal, scale, residuals, g):
     q, k, v, bias, pad, seed, out, lse = residuals
-    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal)
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal, bias)
     n_q, n_k = grid[2], grid[3]
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True

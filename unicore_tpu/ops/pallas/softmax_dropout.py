@@ -30,10 +30,16 @@ from unicore_tpu.ops.backend import pallas_interpret
 from unicore_tpu.ops.pallas.prng import keep_mask
 
 
-def _pick_q_blk(q, k):
-    # keep the x block under ~4MB fp32 in VMEM
-    budget = 1 << 20  # elements
-    blk = min(q, max(8, budget // max(k, 1)))
+def _pick_q_blk(q, k, n_streams=4, itemsize=4):
+    """Row-block size bounded by the Mosaic scoped-VMEM stack: every
+    stream (inputs + outputs) is double-buffered across grid steps, so
+    the stack holds ``2 * n_streams`` blocks of ``q_blk x k`` at once.
+    The 6MB budget keeps well under the 16MB limit (measured: 4 fp32
+    streams at k=2048 with the old fixed element budget stacked 17.83M
+    and failed to compile)."""
+    budget_bytes = 6 << 20
+    denom = max(1, 2 * n_streams * k * itemsize)
+    blk = min(q, max(8, budget_bytes // denom))
     for cand in (256, 128, 64, 32, 16, 8, 1):
         if cand <= blk and q % cand == 0:
             return cand
@@ -134,8 +140,24 @@ def _grid_of(shape, q_blk):
     return tuple(shape[:n_lead]) + (shape[-2] // q_blk,)
 
 
-def _softmax_dropout_fwd_impl(x, mask, bias, dropout_prob, seed, save_softmax):
-    q_blk = _pick_q_blk(x.shape[-2], x.shape[-1])
+def _pick_q_blk_for(x, mask, bias):
+    """ONE q-block size for the forward (with or without grad) and the
+    backward: the per-program dropout seed and mask shape depend on the
+    grid, so every pass MUST tile identically or the backward would drop
+    different elements than the forward did.  Streams are counted for the
+    widest pass (grad-mode forward: x, out, sm + mask/bias); the backward
+    (g, sm, dx) needs no more."""
+    n_streams = (
+        3  # x, out, saved softmax
+        + (1 if mask is not None else 0)
+        + (1 if bias is not None else 0)
+    )
+    return _pick_q_blk(x.shape[-2], x.shape[-1], n_streams=n_streams,
+                       itemsize=x.dtype.itemsize)
+
+
+def _softmax_dropout_fwd_impl(x, mask, bias, dropout_prob, q_blk, seed,
+                              save_softmax):
     n_lead = x.ndim - 2
     k = x.shape[-1]
     grid = _grid_of(x.shape, q_blk)
@@ -176,26 +198,25 @@ def _softmax_dropout_fwd_impl(x, mask, bias, dropout_prob, seed, save_softmax):
     return results[0], None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _softmax_dropout_p(x, mask, bias, dropout_prob, seed):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _softmax_dropout_p(x, mask, bias, dropout_prob, q_blk, seed):
     out, _ = _softmax_dropout_fwd_impl(
-        x, mask, bias, dropout_prob, seed, save_softmax=False
+        x, mask, bias, dropout_prob, q_blk, seed, save_softmax=False
     )
     return out
 
 
-def _fwd(x, mask, bias, dropout_prob, seed):
+def _fwd(x, mask, bias, dropout_prob, q_blk, seed):
     out, sm = _softmax_dropout_fwd_impl(
-        x, mask, bias, dropout_prob, seed, save_softmax=True
+        x, mask, bias, dropout_prob, q_blk, seed, save_softmax=True
     )
     return out, (sm, seed, None if mask is None else mask.shape,
                  None if bias is None else bias.shape)
 
 
-def _bwd(dropout_prob, residuals, g):
+def _bwd(dropout_prob, q_blk, residuals, g):
     sm, seed, mask_shape, bias_shape = residuals
     x_shape = sm.shape
-    q_blk = _pick_q_blk(x_shape[-2], x_shape[-1])
     n_lead = sm.ndim - 2
     grid = _grid_of(x_shape, q_blk)
     xs = _x_spec(x_shape, n_lead, q_blk)
@@ -238,4 +259,5 @@ def softmax_dropout(x, dropout_prob, rng=None, is_training=True, mask=None, bias
         seed = jax.random.randint(rng, (1,), 0, 2**31 - 1, dtype=jnp.int32)
     else:
         seed = jnp.zeros((1,), dtype=jnp.int32)
-    return _softmax_dropout_p(x, mask, bias, p, seed)
+    q_blk = _pick_q_blk_for(x, mask, bias)
+    return _softmax_dropout_p(x, mask, bias, p, q_blk, seed)
