@@ -43,6 +43,8 @@ class TransformerLMModel(BaseUnicoreModel):
     activation_fn: str = "gelu"
     post_ln: bool = False
     rel_pos: bool = True
+    rotary: bool = False
+    abs_pos: bool = True
 
     @staticmethod
     def add_args(parser):
@@ -63,6 +65,15 @@ class TransformerLMModel(BaseUnicoreModel):
                                  "long sequences — the [1,H,T,T] bias tensor "
                                  "grows quadratically, while the bias-free "
                                  "flash path is memory-O(T)")
+        parser.add_argument("--rotary", type=eval_bool,
+                            help="rotary position embeddings (RoPE): O(T*D) "
+                                 "relative positions with no bias tensor — "
+                                 "the long-context choice (typically with "
+                                 "--rel-pos False --abs-pos False)")
+        parser.add_argument("--abs-pos", type=eval_bool,
+                            help="learned absolute position embeddings "
+                                 "(bounded by --max-seq-len); False to rely "
+                                 "on rotary/rel-pos alone")
 
     @classmethod
     def build_model(cls, args, task):
@@ -82,6 +93,9 @@ class TransformerLMModel(BaseUnicoreModel):
             post_ln=args.post_ln,
             rel_pos=args.rel_pos if getattr(args, "rel_pos", None) is not None
             else True,
+            rotary=bool(getattr(args, "rotary", None)),
+            abs_pos=args.abs_pos if getattr(args, "abs_pos", None) is not None
+            else True,
         )
 
     @nn.compact
@@ -94,11 +108,12 @@ class TransformerLMModel(BaseUnicoreModel):
             name="embed_tokens",
         )
         x = embed(src_tokens)
-        pos = self.param(
-            "embed_positions", bert_init,
-            (self.max_seq_len, self.decoder_embed_dim), jnp.float32,
-        )
-        x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
+        if self.abs_pos:
+            pos = self.param(
+                "embed_positions", bert_init,
+                (self.max_seq_len, self.decoder_embed_dim), jnp.float32,
+            )
+            x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
 
         x = TransformerDecoder(
             decoder_layers=self.decoder_layers,
@@ -112,6 +127,7 @@ class TransformerLMModel(BaseUnicoreModel):
             max_seq_len=self.max_seq_len,
             activation_fn=self.activation_fn,
             rel_pos=self.rel_pos,
+            rotary=self.rotary,
             post_ln=self.post_ln,
             auto_regressive=True,
             name="decoder",

@@ -176,6 +176,35 @@ def _worker(pid, port):
     digests = dist_utils.all_gather_objects(float(np.sum(leaf)))
     assert np.allclose(digests[0], digests[1]), digests
 
+    # -- checkpoint round trip under 2 processes ------------------------
+    # process 0 writes; EVERY host reads the same file (SPMD: no
+    # rank-0-read + broadcast_object like the reference trainer.py:356-382)
+    import tempfile
+
+    ckpt_dir = dist_utils.all_gather_objects(
+        tempfile.mkdtemp(prefix="mp_ckpt_") if pid == 0 else None
+    )[0]
+    path = os.path.join(ckpt_dir, "checkpoint_mp.pt")
+    trainer.save_checkpoint(path, {"epoch": 1})
+    # barrier so host 1 never reads a half-written file
+    dist_utils.all_gather_objects(("saved", pid))
+
+    trainer2 = Trainer(args, task, ToyModel(), ToyLoss(task))
+    extra = trainer2.load_checkpoint(path)
+    assert extra is not None and extra.get("epoch") == 1
+    assert trainer2.get_num_updates() == 2
+    l1 = jax.tree_util.tree_leaves(trainer.state["params"])[0]
+    l2 = jax.tree_util.tree_leaves(trainer2.state["params"])[0]
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(l1)), np.asarray(jax.device_get(l2))
+    )
+    # the restored trainer can keep stepping in lockstep
+    metrics.reset()
+    with metrics.aggregate("train"):
+        logs = trainer2.train_step([local_batch(5)])
+    assert float(logs[0]["sample_size"]) == 8 * 8
+    assert trainer2.get_num_updates() == 3
+
     print("WORKER_OK", pid)
 
 

@@ -4,6 +4,11 @@ from unicore_tpu.ops import layer_norm as layer_norm_fn  # noqa: F401
 from unicore_tpu.ops import softmax_dropout  # noqa: F401
 
 from .layer_norm import LayerNorm  # noqa: F401
+from .rotary import (  # noqa: F401
+    apply_rotary,
+    apply_rotary_qk,
+    rotary_cos_sin,
+)
 from .multihead_attention import (  # noqa: F401
     CrossMultiheadAttention,
     SelfMultiheadAttention,

@@ -232,6 +232,8 @@ class SelfMultiheadAttention(nn.Module):
     dropout: float = 0.1
     bias: bool = True
     scaling_factor: float = 1.0
+    rotary: bool = False
+    rotary_base: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -257,6 +259,11 @@ class SelfMultiheadAttention(nn.Module):
         )(query)
         qkv = qkv.reshape(bsz, tgt_len, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        if self.rotary:
+            from .rotary import apply_rotary_qk
+
+            q, k = apply_rotary_qk(q, k, base=self.rotary_base)
 
         bias = _canon_bias(attn_bias, bsz, self.num_heads)
         out = _attend(

@@ -1,0 +1,57 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021).
+
+New capability relative to the reference (whose only position schemes are
+learned absolute embeddings and the bucketed T5 relative bias,
+``unicore/modules/transformer_encoder.py:100-124``): RoPE encodes
+positions as a rotation of the q/k vectors BEFORE the score contraction,
+so attention depends only on relative offsets while costing O(T·D)
+elementwise work — no ``[1, H, T, T]`` bias tensor, which is what makes
+it the long-context-scalable choice next to the quadratic rel-pos bias
+(see docs/performance.md "Long context").  Applied outside the attention
+kernel, it composes with every dispatch path: flash (causal in-block),
+ring/Ulysses sequence parallelism, and the materialized fallback.
+
+Layout [B, T, H, D]; rotate-half formulation: the head dim is split in
+two halves (x1, x2) and rotated as (x1·cos − x2·sin, x2·cos + x1·sin).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rotary_cos_sin(seq_len, dim, base=10000.0, positions=None,
+                   dtype=jnp.float32):
+    """cos/sin tables ``[T, dim//2]``.  ``positions`` (optional ``[T]``)
+    overrides ``arange(T)`` — sequence-parallel callers pass their
+    shard's global offsets."""
+    half = dim // 2
+    inv_freq = 1.0 / (base ** (np.arange(0, half, dtype=np.float64) / half))
+    inv_freq = jnp.asarray(inv_freq, jnp.float32)
+    if positions is None:
+        positions = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        positions = positions.astype(jnp.float32)
+    angles = positions[:, None] * inv_freq[None, :]  # [T, half]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x, cos, sin):
+    """Rotate ``x`` [B, T, H, D] by per-position angles (cos/sin [T, D//2]).
+
+    fp32 rotation regardless of input dtype (the angle tables lose too
+    much phase accuracy in bf16 at long T), cast back on return."""
+    half = x.shape[-1] // 2
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    c = cos.astype(jnp.float32)[None, :, None, :]
+    s = sin.astype(jnp.float32)[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def apply_rotary_qk(q, k, base=10000.0, positions=None):
+    """Rotate q and k ([B, T, H, D]) with shared tables; D must be even."""
+    assert q.shape[-1] % 2 == 0, "rotary needs an even head dim"
+    cos, sin = rotary_cos_sin(q.shape[1], q.shape[-1], base=base,
+                              positions=positions)
+    return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)

@@ -40,6 +40,7 @@ class TransformerDecoderLayer(nn.Module):
     activation_dropout: float = 0.0
     activation_fn: str = "gelu"
     post_ln: bool = False
+    rotary: bool = False
 
     @nn.compact
     def __call__(
@@ -67,6 +68,7 @@ class TransformerDecoderLayer(nn.Module):
             self.embed_dim,
             self.attention_heads,
             dropout=self.attention_dropout,
+            rotary=self.rotary,
             name="self_attn",
         )(x, key_padding_mask=padding_mask, attn_bias=attn_bias,
           deterministic=deterministic, causal=causal)
@@ -123,6 +125,7 @@ class TransformerDecoder(nn.Module):
     max_rel_pos: int = 128
     post_ln: bool = False
     auto_regressive: bool = True
+    rotary: bool = False
 
     @nn.compact
     def __call__(
@@ -172,6 +175,7 @@ class TransformerDecoder(nn.Module):
                 activation_dropout=self.activation_dropout,
                 activation_fn=self.activation_fn,
                 post_ln=self.post_ln,
+                rotary=self.rotary,
                 name=f"layers_{i}",
             )(x,
               encoder_out=encoder_out,

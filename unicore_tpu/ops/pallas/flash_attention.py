@@ -491,9 +491,13 @@ _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 def _common(q, k, causal, bias=None):
     bsz, heads, tq, d = q.shape
     tk = k.shape[2]
-    block_q, block_k = _pick_blocks(
-        tq, tk, 0 if bias is None else bias.dtype.itemsize
+    # a bQ==1 broadcast bias streams only (1, block_k) per step (~KBs) —
+    # shrinking the score block for it would multiply grid steps for no
+    # VMEM relief; only a full (block_q, block_k) bias stream costs budget
+    bias_itemsize = (
+        bias.dtype.itemsize if bias is not None and bias.shape[2] != 1 else 0
     )
+    block_q, block_k = _pick_blocks(tq, tk, bias_itemsize)
     grid = (bsz, heads, tq // block_q, tk // block_k)
     return bsz, heads, tq, tk, d, block_q, block_k, grid
 
