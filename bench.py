@@ -334,6 +334,27 @@ def _microbench(out):
         ), 3,
     )
 
+    # long-context proof, LAST (it is the only micro that can OOM — a
+    # host whose flash probe fails falls back to materialized [B,H,T,T]
+    # scores — and the incremental fill must keep the metrics above):
+    # T=8192 causal decoder fwd+bwd on one chip, the regime the flash
+    # tier exists for (SURVEY §5.7: absent from the reference entirely)
+    from unicore_tpu.modules import TransformerDecoder
+
+    dec = TransformerDecoder(
+        decoder_layers=4, embed_dim=512, ffn_embed_dim=2048,
+        attention_heads=8, max_seq_len=8192, rel_pos=False,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+    )
+    emb = jnp.asarray(rng.randn(1, 8192, 512), jnp.bfloat16)
+    dparams = jax.jit(dec.init)(jax.random.PRNGKey(0), emb)["params"]
+
+    def dec_loss(p):
+        return jnp.mean(dec.apply({"params": p}, emb).astype(jnp.float32) ** 2)
+
+    g_dec = jax.jit(jax.grad(dec_loss))
+    out["causal_t8192_decoder_ms"] = round(_timed(g_dec, dparams) * 1e3, 2)
+
 
 def _e2e_backend_speedup(cfg):
     """Kernel-tier speedup on the REAL train step: auto (pallas kernels +

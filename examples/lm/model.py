@@ -91,12 +91,36 @@ class TransformerLMModel(BaseUnicoreModel):
             max_seq_len=args.max_seq_len,
             activation_fn=args.activation_fn,
             post_ln=args.post_ln,
-            rel_pos=args.rel_pos if getattr(args, "rel_pos", None) is not None
-            else True,
+            rel_pos=cls._rel_pos_default(args),
             rotary=bool(getattr(args, "rotary", None)),
             abs_pos=args.abs_pos if getattr(args, "abs_pos", None) is not None
             else True,
         )
+
+    @staticmethod
+    def _rel_pos_default(args):
+        rotary = bool(getattr(args, "rotary", None))
+        rel_pos = getattr(args, "rel_pos", None)
+        if rel_pos is None:
+            # --rotary exists to AVOID the quadratic [1,H,T,T] bias;
+            # leaving rel-pos on by default would silently rebuild it
+            if rotary:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "--rotary: defaulting --rel-pos False (pass --rel-pos "
+                    "True explicitly to combine both position schemes)"
+                )
+            return not rotary
+        if rel_pos and rotary:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--rotary with --rel-pos True: the quadratic [1,H,T,T] "
+                "rel-pos bias is still built — long-context memory is "
+                "bounded by it, not by RoPE"
+            )
+        return bool(rel_pos)
 
     @nn.compact
     def __call__(self, src_tokens, deterministic=True, **kwargs):

@@ -158,15 +158,12 @@ def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias,
 
 
 def _causal_bias(tq, tk, dtype=jnp.float32):
-    """Additive [1, 1, tq, tk] causal mask built from iota compares — XLA
-    fuses it into the consumer, so no [T, T] tensor lives in HBM (a
-    materialized ``future_mask`` is 256 MB fp32 at T=8192)."""
-    import jax
+    """Additive [1, 1, tq, tk] fused-iota causal mask (shared helper:
+    ``utils.causal_iota_mask``; -1e30 fill like the flash kernel — a
+    literal -inf NaNs fully-masked softmax rows)."""
+    from unicore_tpu.utils import causal_iota_mask
 
-    rows = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-    neg_inf = jnp.asarray(float("-inf"), dtype)
-    return jnp.where(cols > rows, neg_inf, 0.0)[None, None]
+    return causal_iota_mask(tq, tk, dtype=dtype)[None, None]
 
 
 def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,

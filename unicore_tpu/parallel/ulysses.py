@@ -27,13 +27,10 @@ def _local_attention(q, k, v, bias, key_padding_mask, causal, scale):
             key_padding_mask.astype(bool), -1e30, 0.0
         )[:, None, None, :]
     if causal:
-        # iota compares fuse into the score add — a jnp.triu of
-        # jnp.full((t, t)) would materialize a [T, T] fp32 buffer
-        # (256 MB at T=8192)
+        from unicore_tpu.utils import causal_iota_mask
+
         t = q.shape[1]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
-        s = s + jnp.where(cols > rows, -1e30, 0.0)[None, None]
+        s = s + causal_iota_mask(t, t)[None, None]
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return o.astype(q.dtype)

@@ -248,6 +248,22 @@ def batched_gather(data, inds, axis=0, num_batch_dims=0):
     return data[tuple(ranges)]
 
 
+def causal_iota_mask(tq, tk, neg=-1e30, dtype=None):
+    """Additive ``[tq, tk]`` causal mask from iota compares — XLA fuses
+    the comparison into the consumer, so no ``[T, T]`` buffer ever lives
+    in HBM (a ``jnp.triu(jnp.full(...))`` is 256 MB fp32 at T=8192).
+    ``neg`` defaults to a large finite value (a literal -inf NaNs any
+    softmax row that ends up fully masked).  Shared by the materialized
+    attention fallback and the Ulysses local attention."""
+    import jax
+    import jax.numpy as jnp
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    m = jnp.where(cols > rows, neg, 0.0)
+    return m if dtype is None else m.astype(dtype)
+
+
 # ---------------------------------------------------------------------------
 # Misc host helpers
 # ---------------------------------------------------------------------------
