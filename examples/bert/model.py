@@ -129,8 +129,10 @@ class BertModel(BaseUnicoreModel):
         # NOT type=bool: bool("False") is True — eval_bool parses the text
         parser.add_argument("--post-ln", type=eval_bool,
                             help="use post layernorm or pre layernorm")
-        parser.add_argument("--checkpoint-activations", action="store_true",
-                            help="rematerialize encoder-layer activations in backward")
+        parser.add_argument("--checkpoint-activations", type=eval_bool,
+                            nargs="?", const=True, default=False,
+                            help="rematerialize encoder-layer activations in "
+                                 "backward; bare flag or explicit True/False")
         parser.add_argument("--masked-loss-capacity", type=float, metavar="F",
                             help="fraction of tokens given LM-head slots "
                                  "(static-shape masked-token-only vocab "

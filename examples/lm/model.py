@@ -45,6 +45,7 @@ class TransformerLMModel(BaseUnicoreModel):
     rel_pos: bool = True
     rotary: bool = False
     abs_pos: bool = True
+    checkpoint_activations: bool = False
 
     @staticmethod
     def add_args(parser):
@@ -74,6 +75,11 @@ class TransformerLMModel(BaseUnicoreModel):
                             help="learned absolute position embeddings "
                                  "(bounded by --max-seq-len); False to rely "
                                  "on rotary/rel-pos alone")
+        parser.add_argument("--checkpoint-activations", type=eval_bool,
+                            nargs="?", const=True, default=False,
+                            help="rematerialize decoder-layer activations "
+                                 "in backward (memory for FLOPs); bare flag "
+                                 "or explicit True/False")
 
     @classmethod
     def build_model(cls, args, task):
@@ -95,6 +101,9 @@ class TransformerLMModel(BaseUnicoreModel):
             rotary=bool(getattr(args, "rotary", None)),
             abs_pos=args.abs_pos if getattr(args, "abs_pos", None) is not None
             else True,
+            checkpoint_activations=bool(
+                getattr(args, "checkpoint_activations", False)
+            ),
         )
 
     @staticmethod
@@ -153,6 +162,7 @@ class TransformerLMModel(BaseUnicoreModel):
             rel_pos=self.rel_pos,
             rotary=self.rotary,
             post_ln=self.post_ln,
+            checkpoint_activations=self.checkpoint_activations,
             auto_regressive=True,
             name="decoder",
         )(x, padding_mask=padding_mask, deterministic=deterministic)

@@ -98,6 +98,33 @@ def test_decoder_rotary_trains_and_differs_from_absolute(rng):
     assert np.abs(np.asarray(o_r) - np.asarray(o_a)).max() > 1e-4
 
 
+def test_decoder_checkpoint_activations_matches(rng):
+    """Remat must change memory, not math: same outputs and grads with
+    checkpoint_activations on and off."""
+    from unicore_tpu.modules import TransformerDecoder
+
+    x = jnp.asarray(rng.randn(2, 32, 64).astype(np.float32))
+    kw = dict(decoder_layers=2, embed_dim=64, ffn_embed_dim=128,
+              attention_heads=2, max_seq_len=32,
+              emb_dropout=0.0, dropout=0.0, attention_dropout=0.0)
+    dec = TransformerDecoder(checkpoint_activations=False, **kw)
+    dec_r = TransformerDecoder(checkpoint_activations=True, **kw)
+    params = dec.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(mod, p):
+        return jnp.sum(mod.apply({"params": p}, x) ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(dec, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(dec_r, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        ),
+        g0, g1,
+    )
+
+
 def test_self_attention_matches_torch(rng):
     B, T, E, H = 2, 10, 32, 4
     x = rng.randn(B, T, E).astype(np.float32)

@@ -126,6 +126,7 @@ class TransformerDecoder(nn.Module):
     post_ln: bool = False
     auto_regressive: bool = True
     rotary: bool = False
+    checkpoint_activations: bool = False
 
     @nn.compact
     def __call__(
@@ -165,8 +166,15 @@ class TransformerDecoder(nn.Module):
 
         # padding mask intentionally NOT merged into attn_mask (see encoder)
 
+        layer_cls = TransformerDecoderLayer
+        if self.checkpoint_activations:
+            # remat each layer (trade FLOPs for activation memory, same
+            # scheme as the encoder): args passed positionally below;
+            # deterministic (7) and causal (8) are Python bools driving
+            # trace-time control flow, so they must be static
+            layer_cls = nn.remat(layer_cls, static_argnums=(7, 8))
         for i in range(self.decoder_layers):
-            x = TransformerDecoderLayer(
+            x = layer_cls(
                 embed_dim=self.embed_dim,
                 ffn_embed_dim=self.ffn_embed_dim,
                 attention_heads=self.attention_heads,
@@ -177,14 +185,8 @@ class TransformerDecoder(nn.Module):
                 post_ln=self.post_ln,
                 rotary=self.rotary,
                 name=f"layers_{i}",
-            )(x,
-              encoder_out=encoder_out,
-              attn_bias=attn_mask,
-              padding_mask=padding_mask,
-              encoder_attn_bias=encoder_attn_mask,
-              encoder_padding_mask=encoder_padding_mask,
-              deterministic=deterministic,
-              causal=self.auto_regressive)
+            )(x, encoder_out, attn_mask, padding_mask, encoder_attn_mask,
+              encoder_padding_mask, deterministic, self.auto_regressive)
 
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
