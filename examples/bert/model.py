@@ -22,7 +22,7 @@ from unicore_tpu.models import (
     register_model_architecture,
 )
 from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
-from unicore_tpu.utils import eval_bool, get_activation_fn
+from unicore_tpu.utils import arg_bool, eval_bool, get_activation_fn
 
 
 class BertLMHead(nn.Module):
@@ -129,7 +129,7 @@ class BertModel(BaseUnicoreModel):
         # NOT type=bool: bool("False") is True — eval_bool parses the text
         parser.add_argument("--post-ln", type=eval_bool,
                             help="use post layernorm or pre layernorm")
-        parser.add_argument("--checkpoint-activations", type=eval_bool,
+        parser.add_argument("--checkpoint-activations", type=arg_bool,
                             nargs="?", const=True, default=False,
                             help="rematerialize encoder-layer activations in "
                                  "backward; bare flag or explicit True/False")

@@ -15,7 +15,7 @@ from unicore_tpu.models import (
     register_model_architecture,
 )
 from unicore_tpu.modules import LayerNorm, TransformerDecoder, bert_init
-from unicore_tpu.utils import eval_bool, get_activation_fn
+from unicore_tpu.utils import arg_bool, eval_bool, get_activation_fn
 
 
 def _embed_init_with_zero_pad(padding_idx):
@@ -75,7 +75,7 @@ class TransformerLMModel(BaseUnicoreModel):
                             help="learned absolute position embeddings "
                                  "(bounded by --max-seq-len); False to rely "
                                  "on rotary/rel-pos alone")
-        parser.add_argument("--checkpoint-activations", type=eval_bool,
+        parser.add_argument("--checkpoint-activations", type=arg_bool,
                             nargs="?", const=True, default=False,
                             help="rematerialize decoder-layer activations "
                                  "in backward (memory for FLOPs); bare flag "

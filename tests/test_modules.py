@@ -49,11 +49,14 @@ def test_rotary_scores_depend_only_on_relative_offset(rng):
     k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
     pos0 = jnp.arange(T, dtype=jnp.float32)
     shift = 37.0
+    # HIGHEST precision: on TPU the default einsum is single-pass bf16
+    # (~0.07 abs noise here), which would drown the property under test
     for pos in (pos0, pos0 + shift):
         cos, sin = rotary_cos_sin(T, D, positions=pos)
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", apply_rotary(q, cos, sin),
             apply_rotary(k, cos, sin),
+            precision=jax.lax.Precision.HIGHEST,
         )
         if pos is pos0:
             s_base = s

@@ -307,6 +307,23 @@ def eval_bool(x, default=False):
     return default
 
 
+def arg_bool(x):
+    """STRICT boolean argparse type: unknown text raises instead of
+    silently falling back (``--some-flag Ture`` must not parse as False,
+    and a positional path accidentally bound to a ``nargs='?'`` bool flag
+    must error loudly)."""
+    import argparse
+
+    if isinstance(x, bool):
+        return x
+    s = str(x).strip().lower()
+    if s in ("true", "t", "yes", "y", "1"):
+        return True
+    if s in ("false", "f", "no", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {x!r}")
+
+
 def has_parameters(obj):
     """True when a loss/task carries trainable parameters of its own."""
     params = getattr(obj, "params", None)
