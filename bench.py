@@ -32,11 +32,21 @@ A100_REF_SAMPLES_PER_SEC = 185.0
 
 # BERT-base (reference examples/bert/model.py:225-237), vocab padded to a
 # 128-multiple.  Primary config first; ladder of smaller fallbacks after.
+# Batch 64 is the v5e sweet spot: flash attention's O(T) residuals fit it
+# in HBM (the materialized path OOMs above ~48) and it measures ~4% over
+# batch 32; the A100 baseline number itself is a batch-32/GPU run, which
+# stays in the ladder for the apples-to-apples record.
+_BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+_STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 CONFIGS = [
-    dict(batch=int(os.environ.get("BENCH_BATCH", "32")),
-         steps=int(os.environ.get("BENCH_STEPS", "20")), warmup=3, seq=512),
-    dict(batch=16, steps=10, warmup=2, seq=512),
-    dict(batch=8, steps=5, warmup=2, seq=256),
+    dict(batch=_BATCH, steps=_STEPS, warmup=3, seq=512),
+] + ([
+    # batch-32 fallback honors the env step override and is skipped when
+    # the primary already IS batch 32 (no point burning retries twice)
+    dict(batch=32, steps=_STEPS, warmup=3, seq=512),
+] if _BATCH != 32 else []) + [
+    dict(batch=16, steps=min(_STEPS, 10), warmup=2, seq=512),
+    dict(batch=8, steps=min(_STEPS, 5), warmup=2, seq=256),
 ]
 ATTEMPTS_PER_CONFIG = 3
 LAYERS, DIM, FFN, HEADS, VOCAB = 12, 768, 3072, 12, 30528
@@ -364,7 +374,9 @@ def _e2e_backend_speedup(cfg):
     shows up in the full model."""
     from unicore_tpu.ops.backend import kernel_backend
 
-    small = dict(cfg, steps=5, warmup=2)
+    # cap the comparison batch at 32: the all-jnp reference backend's
+    # materialized [B,H,T,T] residuals OOM at the batch-64 primary
+    small = dict(cfg, steps=5, warmup=2, batch=min(cfg["batch"], 32))
 
     # the compiled steps are built once per backend (trace-time backend
     # selection) and reused, so the interleave's repeats cost steps, not

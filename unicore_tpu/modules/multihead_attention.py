@@ -54,24 +54,27 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
     if not fa.eligible(qs, ks, None if bias is None else bias.shape):
         return False
     # measured on v5e (BERT-base, T=512, trainable [1,H,T,T] bias,
-    # dropout): the single-block fused backward makes flash 1.6x faster
-    # than the materialized einsum + fused-softmax path as an ISOLATED op
-    # (5.7 vs 9.1 ms fwd+bwd), but END-TO-END in the 12-layer model the
-    # two tie (best-of-4 interleaved: 192.8 vs 193.7 samples/s) — the
-    # [B,T,H,D]<->[B,H,T,D] transposes around the kernel and the lost
-    # fusion with neighbouring ops eat the win.  Below T=1024 a trainable
-    # bias therefore keeps the materialized path (and in the multi-block
-    # regime the separate dbias recompute sweep makes flash strictly
-    # worse); flash wins once [B,H,Tq,Tk] is HBM-prohibitive.  A forced
-    # "pallas" backend always takes flash.
+    # dropout): in the SINGLE-BLOCK regime the fused backward computes
+    # dq/dk/dv/dbias in one pass; isolated it is 1.6x faster than the
+    # materialized einsum + fused-softmax path, end-to-end the 12-layer
+    # model TIES at batch 32 (192.8 vs 193.7 samples/s interleaved; the
+    # layout transposes around the kernel eat the isolated win) — but
+    # flash's O(T) residual footprint is what fits batch 64 in HBM at all
+    # (229.5 vs 217 samples/s best configs; the materialized path's
+    # per-layer [B,H,T,T] out+softmax residuals OOM), so single-block
+    # flash is preferred.  In the MULTI-block regime a trainable bias
+    # still pays a separate dbias recompute sweep, which loses below
+    # T=1024; flash wins again once [B,H,Tq,Tk] is HBM-prohibitive.  A
+    # forced "pallas" backend always takes flash.
     from unicore_tpu.ops.backend import get_kernel_backend
 
-    if (
-        get_kernel_backend() != "pallas"
-        and bias is not None
-        and k.shape[1] < 1024
-    ):
-        return False
+    if get_kernel_backend() != "pallas" and bias is not None:
+        bq, bk = fa.picked_blocks(
+            q.shape[1], k.shape[1], bias.shape, bias.dtype
+        )
+        single_block = q.shape[1] == bq and k.shape[1] == bk
+        if not single_block and k.shape[1] < 1024:
+            return False
     # fail-open: compile-probe THIS config once per process (dtype/seq
     # lens/bias kind change the BlockSpecs); if it doesn't lower on this
     # backend, use the materialized path instead of crashing training

@@ -488,16 +488,30 @@ def _lse_spec(block_q):
 _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def picked_blocks(tq, tk, bias_shape=None, bias_dtype=None):
+    """The (block_q, block_k) the kernel will use for these shapes —
+    THE block-choice authority, shared by `_common` and the module-level
+    dispatch gate (`_flash_ok` predicts the single-block regime with it;
+    a drifted duplicate would silently misroute dispatch).  A bQ==1
+    broadcast bias streams only (1, block_k) per step (~KBs) — shrinking
+    the score block for it would multiply grid steps for no VMEM relief;
+    only a full (block_q, block_k) bias stream costs budget."""
+    bias_itemsize = (
+        jnp.dtype(bias_dtype).itemsize
+        if bias_shape is not None and bias_shape[2] != 1
+        else 0
+    )
+    return _pick_blocks(tq, tk, bias_itemsize)
+
+
 def _common(q, k, causal, bias=None):
     bsz, heads, tq, d = q.shape
     tk = k.shape[2]
-    # a bQ==1 broadcast bias streams only (1, block_k) per step (~KBs) —
-    # shrinking the score block for it would multiply grid steps for no
-    # VMEM relief; only a full (block_q, block_k) bias stream costs budget
-    bias_itemsize = (
-        bias.dtype.itemsize if bias is not None and bias.shape[2] != 1 else 0
+    block_q, block_k = picked_blocks(
+        tq, tk,
+        None if bias is None else bias.shape,
+        None if bias is None else bias.dtype,
     )
-    block_q, block_k = _pick_blocks(tq, tk, bias_itemsize)
     grid = (bsz, heads, tq // block_q, tk // block_k)
     return bsz, heads, tq, tk, d, block_q, block_k, grid
 
