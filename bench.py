@@ -38,15 +38,15 @@ A100_REF_SAMPLES_PER_SEC = 185.0
 # stays in the ladder for the apples-to-apples record.
 _BATCH = int(os.environ.get("BENCH_BATCH", "64"))
 _STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-CONFIGS = [
-    dict(batch=_BATCH, steps=_STEPS, warmup=3, seq=512),
-] + ([
-    # batch-32 fallback honors the env step override and is skipped when
-    # the primary already IS batch 32 (no point burning retries twice)
-    dict(batch=32, steps=_STEPS, warmup=3, seq=512),
-] if _BATCH != 32 else []) + [
-    dict(batch=16, steps=min(_STEPS, 10), warmup=2, seq=512),
-    dict(batch=8, steps=min(_STEPS, 5), warmup=2, seq=256),
+# fallback ladder: strictly SMALLER batches than the primary (a fallback
+# larger than — or equal to — a config that just failed would only burn
+# retries on something guaranteed to fail harder); honors BENCH_STEPS
+CONFIGS = [dict(batch=_BATCH, steps=_STEPS, warmup=3, seq=512)] + [
+    c for c in (
+        dict(batch=32, steps=_STEPS, warmup=3, seq=512),
+        dict(batch=16, steps=min(_STEPS, 10), warmup=2, seq=512),
+        dict(batch=8, steps=min(_STEPS, 5), warmup=2, seq=256),
+    ) if c["batch"] < _BATCH
 ]
 ATTEMPTS_PER_CONFIG = 3
 LAYERS, DIM, FFN, HEADS, VOCAB = 12, 768, 3072, 12, 30528
@@ -375,7 +375,11 @@ def _e2e_backend_speedup(cfg):
     from unicore_tpu.ops.backend import kernel_backend
 
     # cap the comparison batch at 32: the all-jnp reference backend's
-    # materialized [B,H,T,T] residuals OOM at the batch-64 primary
+    # materialized [B,H,T,T] residuals OOM at the batch-64 primary — the
+    # cap is REPORTED alongside the ratio (at batch 32 flash and the
+    # materialized path tie, so this metric reflects the other kernels;
+    # flash's contribution at the primary batch is the headline number
+    # existing at all)
     small = dict(cfg, steps=5, warmup=2, batch=min(cfg["batch"], 32))
 
     # the compiled steps are built once per backend (trace-time backend
@@ -490,6 +494,7 @@ def main():
                 raise TimeoutError("micro budget exhausted")
             signal.alarm(remaining)
             micro["kernel_tier_e2e_speedup"] = _e2e_backend_speedup(CONFIGS[0])
+            micro["kernel_tier_e2e_batch"] = min(CONFIGS[0]["batch"], 32)
         except Exception as e:  # noqa: BLE001
             micro["kernel_tier_e2e_speedup_error"] = _clean(e)
         finally:
