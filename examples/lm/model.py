@@ -99,37 +99,49 @@ class TransformerLMModel(BaseUnicoreModel):
             post_ln=args.post_ln,
             rel_pos=cls._rel_pos_default(args),
             rotary=bool(getattr(args, "rotary", None)),
-            abs_pos=args.abs_pos if getattr(args, "abs_pos", None) is not None
-            else True,
+            abs_pos=cls._abs_pos_default(args),
             checkpoint_activations=bool(
                 getattr(args, "checkpoint_activations", False)
             ),
         )
 
     @staticmethod
-    def _rel_pos_default(args):
-        rotary = bool(getattr(args, "rotary", None))
-        rel_pos = getattr(args, "rel_pos", None)
-        if rel_pos is None:
-            # --rotary exists to AVOID the quadratic [1,H,T,T] bias;
-            # leaving rel-pos on by default would silently rebuild it
-            if rotary:
-                import logging
+    def _off_when_rotary(args, flag):
+        """Default a position-scheme flag to False under ``--rotary``:
+        RoPE is the position scheme, and silently stacking rel-pos (the
+        quadratic [1,H,T,T] bias) or learned absolute embeddings (bounded
+        by --max-seq-len) on top defeats the long-context intent.
+        NOTE for resumers: runs launched before r4 defaulted --abs-pos
+        True under --rotary; resuming them needs an explicit
+        ``--abs-pos True`` or restore fails on the missing embed table."""
+        import logging
 
+        val = getattr(args, flag.replace("-", "_"), None)
+        rotary = bool(getattr(args, "rotary", None))
+        if val is None:
+            if rotary:
                 logging.getLogger(__name__).info(
-                    "--rotary: defaulting --rel-pos False (pass --rel-pos "
-                    "True explicitly to combine both position schemes)"
+                    "--rotary: defaulting --%s False (pass --%s True "
+                    "explicitly to combine both position schemes; resumes "
+                    "of runs trained with both need the explicit flag)",
+                    flag, flag,
                 )
             return not rotary
-        if rel_pos and rotary:
-            import logging
-
+        if val and rotary and flag == "rel-pos":
             logging.getLogger(__name__).warning(
                 "--rotary with --rel-pos True: the quadratic [1,H,T,T] "
                 "rel-pos bias is still built — long-context memory is "
                 "bounded by it, not by RoPE"
             )
-        return bool(rel_pos)
+        return bool(val)
+
+    @classmethod
+    def _abs_pos_default(cls, args):
+        return cls._off_when_rotary(args, "abs-pos")
+
+    @classmethod
+    def _rel_pos_default(cls, args):
+        return cls._off_when_rotary(args, "rel-pos")
 
     @nn.compact
     def __call__(self, src_tokens, deterministic=True, **kwargs):

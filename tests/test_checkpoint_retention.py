@@ -1,0 +1,92 @@
+"""Unit tests for checkpoint retention + hygiene fixes (VERDICT r3 item 8).
+
+Covers: best-checkpoint pruning with negative metric values (the old
+regex ``(\\d+\\.?\\d*)`` never matched ``-3.21`` so retention silently
+kept everything), and the bottom-right causal-mask alignment.
+"""
+
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from unicore_tpu.checkpoint_utils import _prune, checkpoint_paths
+
+
+def _retention_args(save_dir, keep_best, maximize):
+    return Namespace(
+        save_dir=save_dir,
+        keep_interval_updates=0,
+        keep_last_epochs=0,
+        keep_best_checkpoints=keep_best,
+        best_checkpoint_metric="loss",
+        maximize_best_checkpoint_metric=maximize,
+    )
+
+
+def _touch(d, name):
+    (d / name).write_bytes(b"x")
+
+
+def test_keep_best_prunes_negative_values(tmp_path):
+    # maximized metric (e.g. log-likelihood): best values are the LEAST
+    # negative ones
+    for v in ("-1.25", "-3.50", "-0.75", "-2.00"):
+        _touch(tmp_path, f"checkpoint.best_loss_{v}.pt")
+    args = _retention_args(str(tmp_path), keep_best=2, maximize=True)
+    _prune(args, end_of_epoch=False)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == [
+        "checkpoint.best_loss_-0.75.pt",
+        "checkpoint.best_loss_-1.25.pt",
+    ]
+
+
+def test_keep_best_prunes_minimized_mixed_sign(tmp_path):
+    for v in ("-0.50", "0.25", "1.75", "-2.25"):
+        _touch(tmp_path, f"checkpoint.best_loss_{v}.pt")
+    args = _retention_args(str(tmp_path), keep_best=2, maximize=False)
+    _prune(args, end_of_epoch=False)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == [
+        "checkpoint.best_loss_-0.50.pt",
+        "checkpoint.best_loss_-2.25.pt",
+    ]
+
+
+def test_checkpoint_paths_scientific_notation(tmp_path):
+    _touch(tmp_path, "checkpoint.best_loss_1.5e-03.pt")
+    _touch(tmp_path, "checkpoint.best_loss_2.0e-03.pt")
+    got = checkpoint_paths(
+        str(tmp_path),
+        pattern=r"checkpoint\.best_loss_(-?\d+\.?\d*(?:[eE][+-]?\d+)?)\.pt",
+    )
+    assert [g.split("_")[-1] for g in got] == ["2.0e-03.pt", "1.5e-03.pt"]
+
+
+def test_adam_betas_literal_only():
+    from unicore_tpu.optim.adam import UnicoreAdam
+
+    opt = UnicoreAdam(Namespace(
+        adam_betas="(0.9, 0.98)", adam_eps=1e-8, weight_decay=0.0, lr=[1e-3]
+    ))
+    assert (opt.beta1, opt.beta2) == (0.9, 0.98)
+    with pytest.raises((ValueError, SyntaxError)):
+        UnicoreAdam(Namespace(
+            adam_betas="__import__('os').getcwd()", adam_eps=1e-8,
+            weight_decay=0.0, lr=[1e-3],
+        ))
+
+
+def test_causal_mask_bottom_right_alignment():
+    from unicore_tpu.utils import causal_iota_mask
+
+    # square: ordinary triangle
+    m = np.asarray(causal_iota_mask(4, 4))
+    assert (m[0, 1:] < -1e20).all() and (np.diag(m) == 0).all()
+
+    # tq < tk (incremental decode: queries are the LAST tq positions) —
+    # query row i may see keys <= i + (tk - tq)
+    m = np.asarray(causal_iota_mask(2, 5))
+    assert (m[0, :4] == 0).all() and m[0, 4] < -1e20
+    assert (m[1, :] == 0).all()

@@ -120,8 +120,10 @@ def _prune(args, end_of_epoch):
     if args.keep_last_epochs > 0:
         keep.append((r"checkpoint(\d+)\.pt", args.keep_last_epochs, False))
     if args.keep_best_checkpoints > 0:
+        # value group must admit negatives (maximized log-likelihood/reward)
+        # and scientific notation, or retention silently keeps everything
         keep.append((
-            r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
+            r"checkpoint\.best_{}_(-?\d+\.?\d*(?:[eE][+-]?\d+)?)\.pt".format(
                 args.best_checkpoint_metric),
             args.keep_best_checkpoints,
             not args.maximize_best_checkpoint_metric,

@@ -41,6 +41,12 @@ class BaseWrapperDataset(UnicoreDataset):
 
     @property
     def prefetch_target(self):
+        # a subclass that overrides prefetch() (e.g. with index remapping)
+        # is its own dedup identity: forwarding to the wrapped target would
+        # let NestedDictionaryDataset's id()-based dedup silently skip the
+        # override
+        if type(self).prefetch is not BaseWrapperDataset.prefetch:
+            return self
         return getattr(self.dataset, "prefetch_target", self.dataset)
 
     @property

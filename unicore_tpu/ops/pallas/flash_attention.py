@@ -822,6 +822,15 @@ def flash_attention(
     caller (shard_map) pass its shard's global row origin
     (``axis_index * local_batch``)."""
     bsz, tq, heads, d = q.shape
+    if causal and tq != k.shape[1]:
+        # the kernel's causal triangle compares GLOBAL q/k indices over one
+        # shared sequence grid (top-left alignment); with tq != tk that
+        # silently mis-masks — an incremental-decode caller must slice the
+        # bias path instead (utils.causal_iota_mask is bottom-right aligned)
+        raise ValueError(
+            f"flash_attention(causal=True) requires tq == tk, got "
+            f"{tq} != {k.shape[1]}"
+        )
     if scale is None:
         scale = d ** -0.5
     qt = jnp.transpose(q, (0, 2, 1, 3))

@@ -31,7 +31,9 @@ class UnicoreAdam(UnicoreOptimizer):
         super().__init__(args)
         betas = getattr(args, "adam_betas", "(0.9, 0.999)")
         if isinstance(betas, str):
-            betas = eval(betas)
+            import ast
+
+            betas = ast.literal_eval(betas)
         self.beta1, self.beta2 = float(betas[0]), float(betas[1])
         self.eps = float(getattr(args, "adam_eps", 1e-8))
         self.weight_decay = float(getattr(args, "weight_decay", 0.0))

@@ -254,13 +254,19 @@ def causal_iota_mask(tq, tk, neg=-1e30, dtype=None):
     in HBM (a ``jnp.triu(jnp.full(...))`` is 256 MB fp32 at T=8192).
     ``neg`` defaults to a large finite value (a literal -inf NaNs any
     softmax row that ends up fully masked).  Shared by the materialized
-    attention fallback and the Ulysses local attention."""
+    attention fallback and the Ulysses local attention.
+
+    Alignment is BOTTOM-RIGHT (query i attends keys ``<= i + tk - tq``):
+    for ``tq == tk`` this is the ordinary causal triangle; for ``tq < tk``
+    (KV-cache incremental decode, where the queries are the LAST ``tq``
+    positions of the key stream) each query still sees exactly its own
+    prefix — top-left alignment would silently widen it."""
     import jax
     import jax.numpy as jnp
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-    m = jnp.where(cols > rows, neg, 0.0)
+    m = jnp.where(cols > rows + (tk - tq), neg, 0.0)
     return m if dtype is None else m.astype(dtype)
 
 
@@ -281,10 +287,14 @@ def get_host_memory_gb():
 
 
 def eval_str_list(x, type=float):
+    """Parse ``"(0.9, 0.999)"`` / ``"[1e-4]"`` / ``"0.5"`` into a typed list.
+    Uses ``ast.literal_eval`` — CLI input must never execute code."""
+    import ast
+
     if x is None:
         return None
     if isinstance(x, str):
-        x = eval(x)
+        x = ast.literal_eval(x)
     try:
         return list(map(type, x))
     except TypeError:
