@@ -137,8 +137,9 @@ def test_self_attention_matches_torch(rng):
 
     # reassemble with torch from the same weights
     p = params["params"]
-    w_in = np.asarray(p["in_proj"]["kernel"])  # [E, 3E]
-    b_in = np.asarray(p["in_proj"]["bias"])
+    # DenseGeneral kernel [E, 3, H, Dh] == the reference's [E, 3E] layout
+    w_in = np.asarray(p["in_proj"]["kernel"]).reshape(E, 3 * E)
+    b_in = np.asarray(p["in_proj"]["bias"]).reshape(3 * E)
     w_out = np.asarray(p["out_proj"]["kernel"])
     b_out = np.asarray(p["out_proj"]["bias"])
 

@@ -193,6 +193,7 @@ def _worker(pid, port):
     extra = trainer2.load_checkpoint(path)
     assert extra is not None and extra.get("epoch") == 1
     assert trainer2.get_num_updates() == 2
+    trainer2.init_state(local_batch(0))  # deferred restore materializes
     l1 = jax.tree_util.tree_leaves(trainer.state["params"])[0]
     l2 = jax.tree_util.tree_leaves(trainer2.state["params"])[0]
     np.testing.assert_array_equal(

@@ -16,7 +16,6 @@ from .utils import (  # noqa: F401
     call_main,
     data_sharding,
     distributed_init,
-    fsdp_spec,
     get_data_parallel_rank,
     get_data_parallel_world_size,
     get_mesh,

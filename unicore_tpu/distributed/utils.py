@@ -142,33 +142,88 @@ def data_sharding(mesh, ndim=None):
     )
 
 
-def fsdp_spec(shape, fsdp_size, axis="fsdp"):
-    """FSDP PartitionSpec for one array: shard the largest dim divisible by
-    the fsdp axis size; replicate arrays with no such dim (tiny biases,
-    scalars).  This is the ZeRO sharding rule for master params + optimizer
-    state."""
-    jax = _jax()
-    P = jax.sharding.PartitionSpec
-    if fsdp_size <= 1 or len(shape) == 0:
-        return P()
-    for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
-        if shape[d] >= fsdp_size and shape[d] % fsdp_size == 0:
-            spec = [None] * len(shape)
-            spec[d] = axis
-            return P(*spec)
-    return P()
+# Megatron-style column/row assignment for the transformer param names
+# the module zoo produces (modules/multihead_attention.py,
+# transformer_encoder.py).  Column-parallel layers shard their OUTPUT
+# features (and bias); row-parallel layers shard the CONTRACTION dim and
+# replicate the bias (it adds after the psum).  ``in_proj`` is the fused
+# QKV DenseGeneral — kernel [D, 3, H, Dh] — sharded over the HEAD dim so
+# the sharding propagates through the [B,T,3,H,Dh] activation without
+# resharding.
+_TP_COLUMN = frozenset({"fc1", "q_proj", "k_proj", "v_proj"})
+_TP_ROW = frozenset({"fc2", "out_proj"})
+
+
+def tensor_spec(path_names, shape):
+    """Tensor-parallel axis assignment for one param, or None.
+
+    ``path_names``: string key path into the state tree (the last two
+    components carry the module/param names regardless of the
+    params/exp_avg/ema prefix).  Returns a per-dim list of mesh-axis
+    names (None = unsharded on that dim)."""
+    if len(path_names) < 2:
+        return None
+    mod, leaf = path_names[-2], path_names[-1]
+    if mod == "in_proj":
+        if leaf == "kernel" and len(shape) == 4:
+            return [None, None, "tensor", None]
+        if leaf == "bias" and len(shape) == 3:
+            return [None, "tensor", None]
+        return None
+    if mod in _TP_COLUMN:
+        if leaf == "kernel" and len(shape) == 2:
+            return [None, "tensor"]
+        if leaf == "bias" and len(shape) == 1:
+            return ["tensor"]
+        return None
+    if mod in _TP_ROW and leaf == "kernel" and len(shape) == 2:
+        return ["tensor", None]
+    return None
+
+
+def _path_names(path):
+    out = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)
+        if isinstance(name, str):
+            out.append(name)
+    return out
 
 
 def state_sharding(mesh, tree):
-    """Leaf-wise NamedSharding pytree for a TrainState: params/optimizer
-    leaves shard over the ``fsdp`` axis per :func:`fsdp_spec`; everything
-    that cannot shard (step counters, scaler scalars) replicates."""
+    """Leaf-wise NamedSharding pytree for a TrainState.
+
+    Two composable rules: transformer weights shard Megatron-style over
+    the ``tensor`` axis by name (:func:`tensor_spec`); then the largest
+    still-unsharded divisible dim shards over ``fsdp`` (ZeRO).  Leaves
+    that fit neither (step counters, scaler scalars, tiny biases)
+    replicate.  The rules apply uniformly to params, optimizer moments,
+    and EMA because those subtrees mirror the param key paths."""
     jax = _jax()
-    size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("fsdp", 1)
-    return jax.tree_util.tree_map(
-        lambda x: jax.sharding.NamedSharding(mesh, fsdp_spec(x.shape, size)),
-        tree,
-    )
+    P = jax.sharding.PartitionSpec
+    extent = dict(zip(mesh.axis_names, mesh.devices.shape))
+    fsdp_size = extent.get("fsdp", 1)
+    tp_size = extent.get("tensor", 1)
+
+    def spec_for(path, x):
+        dims = [None] * x.ndim
+        if tp_size > 1 and x.ndim:
+            tp = tensor_spec(_path_names(path), x.shape)
+            if tp is not None:
+                for d, ax in enumerate(tp):
+                    if ax is not None and x.shape[d] % tp_size == 0:
+                        dims[d] = ax
+        if fsdp_size > 1 and x.ndim:
+            for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
+                if (dims[d] is None and x.shape[d] >= fsdp_size
+                        and x.shape[d] % fsdp_size == 0):
+                    dims[d] = "fsdp"
+                    break
+        return jax.sharding.NamedSharding(mesh, P(*dims))
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
 def shard_batch(batch, mesh):

@@ -19,8 +19,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from unicore_tpu import ops
+from unicore_tpu.parallel import tp_constraint
 
 bert_init = nn.initializers.normal(stddev=0.02)
+
+# batch rides (data, fsdp) — the same pair data_sharding() uses
+_BATCH_AXES = ("data", "fsdp")
 
 
 def _canon_bias(bias, bsz, num_heads):
@@ -49,6 +53,18 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
 
     if not use_pallas():
         return False
+    from unicore_tpu.parallel import tensor_parallel_mesh
+
+    tp_mesh = tensor_parallel_mesh()
+    if tp_mesh is not None:
+        tp = dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape))["tensor"]
+        if q.shape[2] % tp == 0:
+            # this layer's heads shard over the tensor axis, and
+            # pallas_call carries no SPMD partitioning rule: GSPMD would
+            # all-gather the head-sharded q/k/v around the kernel,
+            # defeating TP; the einsum path partitions head-wise for free.
+            # (heads not divisible -> the layer replicates; flash is fine)
+            return False
     qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
     ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
     if not fa.eligible(qs, ks, None if bias is None else bias.shape):
@@ -251,13 +267,20 @@ class SelfMultiheadAttention(nn.Module):
         assert head_dim * self.num_heads == self.embed_dim
         scaling = (head_dim * self.scaling_factor) ** -0.5
 
-        qkv = nn.Dense(
-            3 * self.embed_dim,
+        # fused QKV as a DenseGeneral with kernel [D, 3, H, Dh] (same math
+        # and init as a [D, 3D] Dense + reshape — the features axis orders
+        # q-block, k-block, v-block exactly like the reference's in_proj):
+        # keeping (3, H, Dh) as real kernel dims lets tensor parallelism
+        # shard the HEAD dim declaratively and propagate through the
+        # activation with no resharding collective
+        qkv = nn.DenseGeneral(
+            features=(3, self.num_heads, head_dim),
+            axis=-1,
             use_bias=self.bias,
             kernel_init=bert_init,
             name="in_proj",
         )(query)
-        qkv = qkv.reshape(bsz, tgt_len, 3, self.num_heads, head_dim)
+        qkv = tp_constraint(qkv, _BATCH_AXES, None, None, "tensor", None)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         if self.rotary:
@@ -275,11 +298,14 @@ class SelfMultiheadAttention(nn.Module):
             o, attn_weights, probs = out
         else:
             o = out
+        o = tp_constraint(o, _BATCH_AXES, None, "tensor", None)
         o = o.reshape(bsz, tgt_len, embed_dim)
         o = nn.Dense(
             self.embed_dim, use_bias=self.bias, kernel_init=bert_init,
             name="out_proj",
         )(o)
+        # row-parallel output: GSPMD inserts the one allreduce here
+        o = tp_constraint(o, _BATCH_AXES, None, None)
         if return_attn:
             return o, attn_weights, probs
         return o
@@ -311,7 +337,8 @@ class CrossMultiheadAttention(nn.Module):
             y = nn.Dense(
                 self.embed_dim, use_bias=self.bias, kernel_init=bert_init, name=name
             )(x)
-            return y.reshape(y.shape[0], y.shape[1], self.num_heads, head_dim)
+            y = y.reshape(y.shape[0], y.shape[1], self.num_heads, head_dim)
+            return tp_constraint(y, _BATCH_AXES, None, "tensor", None)
 
         q = proj(query, "q_proj")
         k = proj(key, "k_proj")
@@ -320,7 +347,9 @@ class CrossMultiheadAttention(nn.Module):
         bias = _canon_bias(attn_bias, bsz, self.num_heads)
         o = _attend(q, k, v, scaling, self.dropout, key_padding_mask, bias,
                     deterministic, self.make_rng)
+        o = tp_constraint(o, _BATCH_AXES, None, "tensor", None)
         o = o.reshape(bsz, tgt_len, embed_dim)
-        return nn.Dense(
+        o = nn.Dense(
             self.embed_dim, use_bias=self.bias, kernel_init=bert_init, name="out_proj"
         )(o)
+        return tp_constraint(o, _BATCH_AXES, None, None)

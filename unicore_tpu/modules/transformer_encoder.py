@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layer_norm import LayerNorm
-from .multihead_attention import SelfMultiheadAttention, bert_init
+from .multihead_attention import _BATCH_AXES, SelfMultiheadAttention, bert_init
+from unicore_tpu.parallel import tp_constraint
 from unicore_tpu.utils import get_activation_fn
 
 
@@ -150,9 +151,13 @@ class TransformerEncoderLayer(nn.Module):
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
         x = nn.Dense(self.ffn_embed_dim, kernel_init=bert_init, name="fc1")(x)
+        # column-parallel fc1 -> row-parallel fc2: the hidden stays
+        # tensor-sharded through the activation, one allreduce after fc2
+        x = tp_constraint(x, _BATCH_AXES, None, "tensor")
         x = act(x)
         x = drop(x, self.activation_dropout)
         x = nn.Dense(self.embed_dim, kernel_init=bert_init, name="fc2")(x)
+        x = tp_constraint(x, _BATCH_AXES, None, None)
         x = drop(x, self.dropout)
         x = residual + x
         if self.post_ln:

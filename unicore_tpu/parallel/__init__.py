@@ -60,3 +60,60 @@ def sequence_parallel():
     if dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1) <= 1:
         return None
     return mesh, _SEQ_PARALLEL["impl"]
+
+
+# ----------------------------------------------------------------------
+# process-wide tensor-parallel context (Megatron-style, over the mesh's
+# ``tensor`` axis — capability BEYOND the reference: SURVEY §2.4 marks
+# TP "NO").  Weights are sharded declaratively by name-based rules
+# (distributed.utils.tensor_spec); modules add activation constraints
+# here so GSPMD deterministically produces the column-parallel ->
+# row-parallel -> one-allreduce pattern instead of guessing.
+# ----------------------------------------------------------------------
+
+_TENSOR_PARALLEL = {"mesh": None}
+
+
+def enable_tensor_parallel(mesh):
+    """Activate tensor parallelism over ``mesh``'s ``tensor`` axis."""
+    _TENSOR_PARALLEL["mesh"] = mesh
+
+
+def disable_tensor_parallel():
+    _TENSOR_PARALLEL["mesh"] = None
+
+
+def tensor_parallel_mesh():
+    """The active TP mesh, or None (also None when the axis is size 1)."""
+    mesh = _TENSOR_PARALLEL["mesh"]
+    if mesh is None:
+        return None
+    if dict(zip(mesh.axis_names, mesh.devices.shape)).get("tensor", 1) <= 1:
+        return None
+    return mesh
+
+
+def tp_constraint(x, *spec):
+    """``with_sharding_constraint`` over the active TP mesh, or identity.
+
+    ``spec`` entries are mesh-axis names (or tuples of them) / None, one
+    per dim of ``x``.  Falls back to identity when any named-axis dim is
+    not divisible by its mesh extent — a shape that cannot shard must not
+    crash the trace (mirrors state_sharding's replicate-on-misfit rule)."""
+    mesh = tensor_parallel_mesh()
+    if mesh is None:
+        return x
+    import jax
+
+    extent = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for dim, ax in zip(x.shape, spec):
+        if ax is None:
+            continue
+        n = 1
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            n *= extent.get(a, 1)
+        if n > 1 and dim % n != 0:
+            return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+    )
