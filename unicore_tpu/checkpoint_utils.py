@@ -58,6 +58,93 @@ def atomic_save(obj, filename, retries=3):
 torch_persistent_save = atomic_save
 
 
+# ----------------------------------------------------------------------
+# sharded checkpoints (beyond the reference — its rank-0 write gathers
+# full state on one host, checkpoint_utils.py:282-299; here each process
+# writes only the shards it owns, so no host ever materializes state it
+# does not hold)
+# ----------------------------------------------------------------------
+
+class ShardedLeaf:
+    """Placeholder in the main checkpoint tree for a leaf whose data lives
+    in per-process ``<name>.pt.shard<p>`` files.  Carries shape/dtype so
+    restore can validate against the model without touching shard data."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"ShardedLeaf(shape={self.shape}, dtype={self.dtype})"
+
+
+def shard_file(path, process_index):
+    return f"{path}.shard{process_index}"
+
+
+def write_checkpoint(state_dict, shard_entries, filename, is_master,
+                     process_index, shard_token=None):
+    """Write the main file (master) and this process's shard file (if it
+    owns any sharded pieces).  ``shard_entries``: {leaf-path:
+    [((start, stop) per dim, np-array), ...]}.  ``shard_token`` binds the
+    shard files to THIS save of the main file: a restart with fewer
+    processes leaves stale higher-numbered ``.shard*`` siblings around,
+    and restore must be able to reject them instead of silently merging
+    old weights in."""
+    if shard_entries:
+        atomic_save(
+            {
+                "process_index": process_index,
+                "token": shard_token,
+                "entries": shard_entries,
+            },
+            shard_file(filename, process_index),
+        )
+    if is_master:
+        atomic_save(state_dict, filename)
+
+
+def load_shard_entries(path, process_index=None, token=None):
+    """Read shard entries for one process (or ALL shard files when
+    ``process_index`` is None — the topology-changed fallback).  Files
+    whose token does not match the main file's are STALE (left by an
+    earlier save with more processes) and are skipped with a warning.
+    Returns {leaf-path: [(index, np), ...]} merged across files."""
+    import glob
+
+    if process_index is not None:
+        files = [shard_file(path, process_index)]
+        if not os.path.exists(files[0]):
+            return {}
+    else:
+        files = sorted(glob.glob(path + ".shard*"))
+    merged = {}
+    for fn in files:
+        with open(fn, "rb") as f:
+            payload = pickle.load(f)
+        if token is not None and payload.get("token") != token:
+            logger.warning(
+                "ignoring stale shard file %s (token %r != %r)",
+                fn, payload.get("token"), token,
+            )
+            continue
+        for key, entries in payload["entries"].items():
+            merged.setdefault(key, []).extend(entries)
+    return merged
+
+
+def has_shard_files(path):
+    import glob
+
+    return bool(glob.glob(path + ".shard*"))
+
+
 def checkpoint_exists(path):
     return os.path.exists(path)
 
@@ -128,14 +215,21 @@ def _prune(args, end_of_epoch):
             args.keep_best_checkpoints,
             not args.maximize_best_checkpoint_metric,
         ))
+    import glob
+
     for pattern, limit, reverse in keep:
         survivors = checkpoint_paths(args.save_dir, pattern=pattern)
         if reverse:
             survivors = survivors[::-1]
         for stale in survivors[limit:]:
-            if os.path.lexists(stale):
-                os.remove(stale)
-                logger.info("removed old checkpoint %s", stale)
+            # shard siblings go with the main file; removals are guarded
+            # (multi-process pruning races are benign on a shared FS)
+            for path in [stale] + glob.glob(stale + ".shard*"):
+                try:
+                    os.remove(path)
+                    logger.info("removed old checkpoint %s", path)
+                except FileNotFoundError:
+                    pass
 
 
 # ----------------------------------------------------------------------
@@ -203,9 +297,16 @@ class CheckpointManager:
         return names
 
     def save(self, trainer, epoch_itr, val_loss, do_save=True):
-        """Write this round's checkpoint under every applicable name."""
+        """Write this round's checkpoint under every applicable name.
+
+        Every process participates: the master writes the main file;
+        every process holding sharded state (fsdp/tensor axes spanning
+        processes) writes its ``.shard<p>`` sibling.  The device->host
+        fetch happens here synchronously (the arrays are donated to the
+        next step), but pickling + IO + copy + retention run on the
+        background worker — the step path never waits on the disk."""
         improved = self.best.update(val_loss)
-        if self.args.no_save or not do_save or not trainer.is_data_parallel_master:
+        if self.args.no_save or not do_save:
             return
         epoch = epoch_itr.epoch
         end_of_epoch = epoch_itr.end_of_epoch()
@@ -224,45 +325,82 @@ class CheckpointManager:
 
         import time
         t0 = time.perf_counter()
+        is_master = trainer.is_data_parallel_master
+        try:
+            state_dict, shard_entries = trainer.collect_checkpoint_state(
+                extra_state
+            )
+        except Exception:
+            logger.error(
+                "checkpoint state collection FAILED; skipping save for "
+                "this round", exc_info=True,
+            )
+            return
+        if not is_master and not shard_entries:
+            return  # pure DP non-master: nothing to persist
         scratch = os.path.join(self.args.tmp_save_dir, names[0])
         finals = [os.path.join(self.args.save_dir, n) for n in names]
+        import jax
+
+        job = (state_dict, shard_entries, scratch, finals, end_of_epoch,
+               is_master, jax.process_index())
+        if self._worker is None:
+            # lazily provision a worker on shard-owning non-master hosts
+            verify_checkpoint_directory(self.args.save_dir)
+            verify_checkpoint_directory(self.args.tmp_save_dir)
+            self._worker = ThreadPool(processes=1)
+        self._worker.apply_async(self._write_and_finalize, job)
+        logger.info(
+            "Saving checkpoint %s (epoch %d @ %d updates, score %s) "
+            "(state collection took %.1f seconds; write is async)",
+            scratch, epoch, updates, val_loss, time.perf_counter() - t0,
+        )
+
+    def _write_and_finalize(self, state_dict, shard_entries, scratch,
+                            finals, end_of_epoch, is_master, process_index):
+        """Worker-thread body: serialize, copy to final names, prune."""
         try:
-            trainer.save_checkpoint(scratch, extra_state)
+            write_checkpoint(
+                state_dict, shard_entries, scratch, is_master, process_index,
+                shard_token=state_dict.get("shard_token"),
+            )
         except Exception:
             logger.error(
                 "checkpoint write to %s FAILED; skipping copy/retention for "
                 "this round", scratch, exc_info=True,
             )
             return
-        job = (scratch, finals, end_of_epoch)
-        if self._worker is not None:
-            self._worker.apply_async(self._finalize, job)
-        else:
-            self._finalize(*job)
-        logger.info(
-            "Saved checkpoint %s (epoch %d @ %d updates, score %s) "
-            "(writing took %.1f seconds)",
-            scratch, epoch, updates, val_loss, time.perf_counter() - t0,
-        )
+        self._finalize(scratch, finals, end_of_epoch, is_master,
+                       bool(shard_entries), process_index)
 
-    def _finalize(self, scratch, finals, end_of_epoch):
+    def _finalize(self, scratch, finals, end_of_epoch, is_master=True,
+                  has_shards=False, process_index=0):
         """Copy the scratch write to its final names, then prune."""
         copied_any = False
+        pairs = []
         for dst in finals:
-            if dst == scratch:
+            if is_master:
+                pairs.append((scratch, dst))
+            if has_shards:
+                pairs.append((shard_file(scratch, process_index),
+                              shard_file(dst, process_index)))
+        for src, dst in pairs:
+            if dst == src:
                 continue
             try:
-                shutil.copyfile(scratch, dst)
+                shutil.copyfile(src, dst)
                 copied_any = True
-                logger.info("copied %s -> %s", scratch, dst)
+                logger.info("copied %s -> %s", src, dst)
             except Exception:
                 logger.warning("checkpoint copy to %s failed; copy manually",
                                dst)
         try:
-            if (copied_any and self.args.tmp_save_dir != self.args.save_dir
-                    and os.path.lexists(scratch)):
-                os.remove(scratch)
-            _prune(self.args, end_of_epoch)
+            if copied_any and self.args.tmp_save_dir != self.args.save_dir:
+                for p in (scratch, shard_file(scratch, process_index)):
+                    if os.path.lexists(p):
+                        os.remove(p)
+            if is_master or has_shards:
+                _prune(self.args, end_of_epoch)
         except Exception:
             logger.warning("checkpoint retention pass failed", exc_info=True)
 
