@@ -1,0 +1,5 @@
+"""Full-Evoformer example plugin (MSA stack + pair stack): registered via
+--user-dir, exercising the complete Uni-Fold Evoformer workload shape
+(BASELINE north star configs[2])."""
+
+from . import loss, model, task  # noqa: F401

@@ -1,0 +1,59 @@
+"""Masked per-pair MSE loss for the full-Evoformer example.
+
+Same contract as the pair example's ``pair_mse`` plus the MSA mask
+threaded into the model (row/column attention and the outer-product-mean
+normalize by it)."""
+
+import math
+
+import jax.numpy as jnp
+
+from unicore_tpu import metrics
+from unicore_tpu.losses import UnicoreLoss, register_loss
+
+
+@register_loss("evoformer_mse")
+class EvoformerMSELoss(UnicoreLoss):
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        target = sample["target"]
+        pair_mask = sample.get("pair_mask")
+        msa_mask = sample.get("msa_mask")
+        pred = model.apply(
+            {"params": params},
+            **sample["net_input"],
+            msa_mask=msa_mask,
+            pair_mask=pair_mask,
+            deterministic=not is_training,
+            rngs={"dropout": rng} if (is_training and rng is not None) else None,
+        )
+        err2 = (pred.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+        if pair_mask is not None:
+            w = pair_mask.astype(jnp.float32)
+            loss = jnp.sum(err2 * w)
+            sample_size = jnp.sum(w)
+        else:
+            loss = jnp.sum(err2)
+            sample_size = jnp.asarray(err2.size, dtype=jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "sample_size": sample_size,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train"):
+        loss = sum(float(l.get("loss", 0)) for l in logging_outputs)
+        n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+        bsz = sum(float(l.get("bsz", 0)) for l in logging_outputs)
+        mse = loss / max(n, 1.0)
+        metrics.log_scalar("loss", mse, n, round=4)
+        metrics.log_scalar("bsz", bsz / max(len(logging_outputs), 1),
+                           priority=190, round=1)
+        metrics.log_derived(
+            "rmse", lambda m: math.sqrt(max(m["loss"].avg, 0.0))
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train):
+        return True

@@ -1,0 +1,107 @@
+"""Evoformer task: records carry an MSA, square pair features, and a
+per-pair scalar target.
+
+Record schema (see ``example_data/make_data.py``):
+    {"msa":       float32 [S, R, A]  — one-hot MSA rows
+     "pair":      float32 [R, R, F]  — binned/noisy pairwise features
+     "target":    float32 [R, R]     — the quantity to regress
+     "msa_mask":  float32 [S, R]     — 1 = valid MSA cell (optional)
+     "pair_mask": float32 [R, R]     — 1 = valid pair (optional)}
+
+S, R fixed per dataset (static shapes = one jit compile for the run).
+"""
+
+import logging
+import os
+
+import numpy as np
+
+from unicore_tpu.data import (
+    BaseWrapperDataset,
+    NestedDictionaryDataset,
+    SortDataset,
+    best_record_dataset,
+    data_utils,
+)
+from unicore_tpu.tasks import UnicoreTask, register_task
+
+logger = logging.getLogger(__name__)
+
+
+class _Field(BaseWrapperDataset):
+    """View one key of a dict-record dataset; collates by stacking."""
+
+    def __init__(self, dataset, key, default=None):
+        super().__init__(dataset)
+        self.key = key
+        self.default = default
+
+    def __getitem__(self, index):
+        rec = self.dataset[index]
+        if self.key not in rec and self.default is not None:
+            return self.default(rec)
+        return np.asarray(rec[self.key], dtype=np.float32)
+
+    def collater(self, samples):
+        return np.stack([np.asarray(s) for s in samples])
+
+
+@register_task("evoformer")
+class EvoformerTask(UnicoreTask):
+    """Regress a per-pair scalar from an MSA + pair representation."""
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("data", help="directory with {split}.rec")
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.seed = args.seed
+
+    @classmethod
+    def setup_task(cls, args, **kwargs):
+        return cls(args)
+
+    def load_dataset(self, split, combine=False, **kwargs):
+        split_path = os.path.join(self.args.data, split)
+        for ext in (".lmdb", ".rec"):
+            if os.path.exists(split_path + ext) or os.path.exists(
+                split_path + ext + ".idx"
+            ):
+                split_path = split_path + ext
+                break
+
+        dataset = best_record_dataset(split_path)
+
+        def all_valid_pair(rec):
+            n = np.asarray(rec["target"]).shape[0]
+            return np.ones((n, n), dtype=np.float32)
+
+        def all_valid_msa(rec):
+            s, r = np.asarray(rec["msa"]).shape[:2]
+            return np.ones((s, r), dtype=np.float32)
+
+        with data_utils.numpy_seed(self.args.seed):
+            shuffle = np.random.permutation(len(dataset))
+
+        self.datasets[split] = SortDataset(
+            NestedDictionaryDataset(
+                {
+                    "net_input": {
+                        "msa": _Field(dataset, "msa"),
+                        "pair": _Field(dataset, "pair"),
+                    },
+                    "target": _Field(dataset, "target"),
+                    "msa_mask": _Field(dataset, "msa_mask",
+                                       default=all_valid_msa),
+                    "pair_mask": _Field(dataset, "pair_mask",
+                                        default=all_valid_pair),
+                }
+            ),
+            sort_order=[shuffle],
+        )
+
+    def build_model(self, args):
+        from unicore_tpu import models
+
+        return models.build_model(args, self)

@@ -198,3 +198,150 @@ def test_evoformer_trainer_step_end_to_end(rng):
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]  # it learns
     assert trainer.get_num_updates() == 8
+
+
+# ---------------------------------------------------------------------------
+# MSA half (VERDICT r3 missing-2): row attention with pair bias, column
+# attention, outer product mean, and the full EvoformerBlock
+# ---------------------------------------------------------------------------
+
+S = 4  # sequences
+
+
+def test_msa_row_attention_oracle(rng):
+    """Row attention == explicit jnp composition (softmax over the last
+    dim of scores + pair bias + mask), including the [B,1,H,R,R] bias and
+    [B,S,1,1,R] mask broadcast contracts."""
+    from unicore_tpu.modules import MSARowAttentionWithPairBias
+
+    msa = jnp.asarray(rng.randn(B, S, N, C).astype(np.float32))
+    z = jnp.asarray(rng.randn(B, N, N, C).astype(np.float32))
+    mask = np.ones((B, S, N), dtype=np.float32)
+    mask[:, :, N - 2:] = 0.0
+    mod = MSARowAttentionWithPairBias(embed_dim=C, num_heads=H)
+    params = mod.init(
+        jax.random.PRNGKey(0), msa, z, jnp.asarray(mask)
+    )["params"]
+    out = mod.apply({"params": params}, msa, z, jnp.asarray(mask))
+    assert out.shape == msa.shape and np.isfinite(np.asarray(out)).all()
+
+    # oracle: rebuild from the params with explicit ops
+    p = params
+    m = nn.LayerNorm().apply({"params": p["layer_norm"]}, msa)
+    head_dim = C // H
+
+    def proj(name):
+        y = m @ p[name]["kernel"]
+        return y.reshape(B, S, N, H, head_dim)
+
+    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+    scores = jnp.einsum("bsqhd,bskhd->bshqk", q * head_dim ** -0.5, k)
+    zn = nn.LayerNorm().apply({"params": p["pair_norm"]}, z)
+    bias = jnp.transpose(zn @ p["pair_bias"]["kernel"], (0, 3, 1, 2))[:, None]
+    add = jnp.where(jnp.asarray(mask).astype(bool), 0.0, -1e9)[:, :, None, None, :]
+    probs = jax.nn.softmax(
+        (scores + bias + add).astype(jnp.float32), axis=-1
+    ).astype(scores.dtype)
+    o = jnp.einsum("bshqk,bskhd->bsqhd", probs, v).reshape(B, S, N, C)
+    gate = jax.nn.sigmoid(m @ p["gate"]["kernel"] + p["gate"]["bias"])
+    want = (o * gate) @ p["out_proj"]["kernel"] + p["out_proj"]["bias"]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), **ORACLE_TOL
+    )
+
+
+def test_msa_column_attention_mask(rng):
+    """Masked MSA rows must not influence valid rows' outputs (attention
+    over sequences per residue column)."""
+    from unicore_tpu.modules import MSAColumnAttention
+
+    msa = rng.randn(B, S, N, C).astype(np.float32)
+    mask = np.ones((B, S, N), dtype=np.float32)
+    mask[:, S - 1, :] = 0.0  # last sequence row invalid
+    mod = MSAColumnAttention(embed_dim=C, num_heads=H)
+    params = mod.init(
+        jax.random.PRNGKey(0), jnp.asarray(msa), jnp.asarray(mask)
+    )["params"]
+    out1 = mod.apply({"params": params}, jnp.asarray(msa), jnp.asarray(mask))
+    msa2 = msa.copy()
+    msa2[:, S - 1, :, :] += 100.0  # perturb ONLY the masked row
+    out2 = mod.apply({"params": params}, jnp.asarray(msa2), jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(out1[:, : S - 1]), np.asarray(out2[:, : S - 1]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_outer_product_mean_oracle(rng):
+    """OPM == per-pair loop oracle, with the mask-count normalization."""
+    from unicore_tpu.modules import OuterProductMean
+
+    HID = 4
+    msa = jnp.asarray(rng.randn(B, S, N, C).astype(np.float32))
+    mask = (rng.rand(B, S, N) > 0.3).astype(np.float32)
+    mod = OuterProductMean(pair_dim=C, hidden_dim=HID)
+    params = mod.init(
+        jax.random.PRNGKey(0), msa, jnp.asarray(mask)
+    )["params"]
+    out = mod.apply({"params": params}, msa, jnp.asarray(mask))
+    assert out.shape == (B, N, N, C)
+
+    p = params
+    m = np.asarray(nn.LayerNorm().apply({"params": p["layer_norm"]}, msa))
+    a = (m @ np.asarray(p["a_proj"]["kernel"])) * mask[..., None]
+    b = (m @ np.asarray(p["b_proj"]["kernel"])) * mask[..., None]
+    want = np.zeros((B, N, N, HID * HID), dtype=np.float32)
+    for bi in range(B):
+        for i in range(N):
+            for j in range(N):
+                outer = np.einsum("sc,sd->cd", a[bi, :, i], b[bi, :, j])
+                norm = max(float((mask[bi, :, i] * mask[bi, :, j]).sum()), 1e-3)
+                want[bi, i, j] = (outer / norm).reshape(-1)
+    want = want @ np.asarray(p["out_proj"]["kernel"]) + np.asarray(
+        p["out_proj"]["bias"]
+    )
+    np.testing.assert_allclose(np.asarray(out), want, **ORACLE_TOL)
+
+
+def test_evoformer_block_fwd_bwd(rng):
+    """The full block (MSA half + OPM + pair half) steps fwd+bwd with
+    finite grads into every param — the 'Evoformer block steps fwd+bwd'
+    done-condition of VERDICT r3 next-4."""
+    from unicore_tpu.modules import EvoformerBlock
+
+    msa = jnp.asarray(rng.randn(B, S, N, C).astype(np.float32))
+    z = jnp.asarray(rng.randn(B, N, N, C).astype(np.float32))
+    msa_mask = jnp.asarray(np.ones((B, S, N), dtype=np.float32))
+    pair_mask = jnp.asarray(np.ones((B, N, N), dtype=np.float32))
+    mod = EvoformerBlock(msa_dim=C, pair_dim=C, msa_heads=H, pair_heads=H)
+    params = mod.init(
+        jax.random.PRNGKey(0), msa, z, msa_mask, pair_mask
+    )["params"]
+    # perturb away from init: the zero-initialized output projections
+    # (AlphaFold-style) make everything upstream of them zero-grad at
+    # exactly step 0, which is init policy, not a dead submodule
+    keys = jax.random.split(jax.random.PRNGKey(1), len(jax.tree_util.tree_leaves(params)))
+    params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [
+            leaf + 0.02 * jax.random.normal(k, leaf.shape, leaf.dtype)
+            for leaf, k in zip(jax.tree_util.tree_leaves(params), keys)
+        ],
+    )
+
+    def loss(p):
+        m2, z2 = mod.apply({"params": p}, msa, z, msa_mask, pair_mask)
+        return jnp.sum(m2.astype(jnp.float32) ** 2) + jnp.sum(
+            z2.astype(jnp.float32) ** 2
+        )
+
+    g = jax.grad(loss)(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert flat and all(np.isfinite(np.asarray(l)).all() for l in flat)
+    # every parameter receives gradient (no dead submodule)
+    dead = [
+        "/".join(str(k.key) for k in path)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(g)
+        if float(jnp.sum(jnp.abs(leaf))) == 0.0
+    ]
+    assert not dead, f"zero-grad params: {dead}"

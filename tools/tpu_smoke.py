@@ -79,6 +79,31 @@ def _smoke_evoformer():
     jax.block_until_ready(g)
 
 
+def _smoke_evoformer_full():
+    """The COMPLETE Evoformer block (MSA row attention with pair bias +
+    column attention + transition + outer-product-mean + pair half) runs
+    fwd+bwd on the chip — the VERDICT r3 next-4 done-condition, at
+    Uni-Fold-ish widths (msa 256 / pair 128)."""
+    from unicore_tpu.modules import EvoformerBlock
+
+    mod = EvoformerBlock(msa_dim=256, pair_dim=128, msa_heads=8,
+                         pair_heads=4, opm_hidden_dim=32)
+    msa = jnp.zeros((1, 32, 128, 256), jnp.float32)
+    z = jnp.zeros((1, 128, 128, 128), jnp.float32)
+    msa_mask = jnp.ones((1, 32, 128), jnp.float32)
+    pair_mask = jnp.ones((1, 128, 128), jnp.float32)
+    params = jax.jit(mod.init)(
+        jax.random.PRNGKey(0), msa, z, msa_mask, pair_mask
+    )["params"]
+
+    def f(p):
+        m2, z2 = mod.apply({"params": p}, msa, z, msa_mask, pair_mask)
+        return jnp.sum(m2 ** 2) + jnp.sum(z2 ** 2)
+
+    g = jax.jit(jax.grad(f))(params)
+    jax.block_until_ready(g)
+
+
 def main():
     backend = jax.default_backend()
     print(f"backend: {backend} ({jax.devices()[0].device_kind})")
@@ -94,6 +119,7 @@ def main():
         ("softmax_dropout", _smoke_softmax_dropout),
         ("fp32_to_bf16_sr", _smoke_rounding),
         ("evoformer_pair_block", _smoke_evoformer),
+        ("evoformer_full_block", _smoke_evoformer_full),
     ]:
         try:
             fn()

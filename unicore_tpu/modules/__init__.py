@@ -31,3 +31,10 @@ from .triangle_attention import (  # noqa: F401
     TriangleAttention,
     TriangleMultiplication,
 )
+from .msa_attention import (  # noqa: F401
+    EvoformerBlock,
+    MSAColumnAttention,
+    MSARowAttentionWithPairBias,
+    MSATransition,
+    OuterProductMean,
+)

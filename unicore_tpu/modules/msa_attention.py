@@ -1,0 +1,215 @@
+"""MSA-stack modules: the other half of the Uni-Fold Evoformer workload.
+
+The reference framework ships no Evoformer — Uni-Fold plugs into it — but
+its fused softmax is explicitly shaped for these calls: the broadcast
+contracts of ``/root/reference/unicore/modules/softmax_dropout.py:53-99``,
+exercised by ``/root/reference/tests/test_softmax.py:81-170``, exist FOR
+the MSA/pair attention below.  Row-wise gated attention with pair bias is
+the heaviest consumer: scores ``[B, S, H, R, R]`` (S = sequences as the
+group dim), the pair bias broadcasts over S (``[B, 1, h, q, k]``, the
+tri_softmax1 bias contract) and the MSA mask broadcasts over heads and
+queries (``[B, S, 1, 1, k]``, the tri_softmax1 mask contract) — all
+through :func:`unicore_tpu.ops.softmax_dropout`, which routes the 5-D
+broadcasts into the Pallas kernel on TPU.
+
+Shapes follow AlphaFold's Evoformer (Algorithms 7-10): MSA representation
+``m``: [B, S, R, C_m] (S sequences x R residues); pair representation
+``z``: [B, R, R, C_z].  Implementation is independent — written from the
+algorithm, structured like the sibling ``triangle_attention`` module.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu import ops
+
+bert_init = nn.initializers.normal(stddev=0.02)
+
+
+def _mask_to_additive(mask):
+    """[B, S, R] validity mask -> additive [B, S, 1, 1, R] (broadcast over
+    heads and queries; finite fill so fully-masked rows don't NaN)."""
+    if mask is None:
+        return None
+    return jnp.where(
+        mask.astype(bool), 0.0, -1e9
+    ).astype(jnp.float32)[:, :, None, None, :]
+
+
+def _gated_attention(self, m, bias, add_mask, deterministic):
+    """Shared gated-attention body over a [B, G, Q, C] tensor (flax
+    in-place-of-method helper: call from inside an ``@nn.compact``
+    ``__call__`` so the q/k/v/gate/out submodules land on the caller).
+    ``bias``/``add_mask`` broadcast against scores [B, G, H, Q, Q]."""
+    bsz, g, q_len, _ = m.shape
+    head_dim = self.embed_dim // self.num_heads
+    assert head_dim * self.num_heads == self.embed_dim
+    scale = head_dim ** -0.5
+
+    def proj(name):
+        y = nn.Dense(self.embed_dim, use_bias=False,
+                     kernel_init=bert_init, name=name)(m)
+        return y.reshape(bsz, g, q_len, self.num_heads, head_dim)
+
+    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+    scores = jnp.einsum("bsqhd,bskhd->bshqk", q * scale, k)
+
+    rng = None
+    if not deterministic and self.dropout > 0.0:
+        rng = self.make_rng("dropout")
+    probs = ops.softmax_dropout(
+        scores, self.dropout, rng=rng, is_training=not deterministic,
+        mask=add_mask, bias=bias,
+    )
+    o = jnp.einsum("bshqk,bskhd->bsqhd", probs, v)
+    o = o.reshape(bsz, g, q_len, self.embed_dim)
+    gate = nn.sigmoid(
+        nn.Dense(self.embed_dim, kernel_init=nn.initializers.zeros,
+                 bias_init=nn.initializers.ones, name="gate")(m)
+    )
+    return nn.Dense(
+        self.embed_dim, kernel_init=bert_init, name="out_proj"
+    )(o * gate)
+
+
+class MSARowAttentionWithPairBias(nn.Module):
+    """Gated row-wise MSA self-attention biased by the pair representation
+    (AlphaFold Algorithm 7).  Each sequence row attends across residues;
+    the bias projected from ``z`` is shared by every row — the
+    group-broadcast the reference kernel's ``bias_batch_count`` modulo
+    trick existed for (``softmax_dropout_kernel.cu:86``)."""
+
+    embed_dim: int          # C_m
+    num_heads: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, msa, z, msa_mask=None, deterministic: bool = True):
+        """msa: [B, S, R, C_m]; z: [B, R, R, C_z]; msa_mask: [B, S, R]."""
+        m = nn.LayerNorm(name="layer_norm")(msa)
+
+        # pair bias [B, R, R, C_z] -> [B, 1, H, R, R] (broadcast over S)
+        zb = nn.LayerNorm(name="pair_norm")(z)
+        pair_bias = nn.Dense(
+            self.num_heads, use_bias=False, kernel_init=bert_init,
+            name="pair_bias",
+        )(zb)
+        pair_bias = jnp.transpose(pair_bias, (0, 3, 1, 2))[:, None]
+
+        return _gated_attention(
+            self, m, pair_bias, _mask_to_additive(msa_mask), deterministic
+        )
+
+
+class MSAColumnAttention(nn.Module):
+    """Gated column-wise MSA self-attention (AlphaFold Algorithm 8): each
+    residue column attends across sequences — transpose in, run the row
+    machinery without a pair bias, transpose out."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, msa, msa_mask=None, deterministic: bool = True):
+        """msa: [B, S, R, C_m]; msa_mask: [B, S, R]."""
+        mt = jnp.swapaxes(msa, 1, 2)  # [B, R, S, C]
+        mask = None if msa_mask is None else jnp.swapaxes(msa_mask, 1, 2)
+        m = nn.LayerNorm(name="layer_norm")(mt)
+        o = _gated_attention(
+            self, m, None, _mask_to_additive(mask), deterministic
+        )
+        return jnp.swapaxes(o, 1, 2)
+
+
+class MSATransition(nn.Module):
+    """MSA transition (Algorithm 9): LN -> widen x n -> gelu -> project."""
+
+    embed_dim: int
+    widening: int = 4
+
+    @nn.compact
+    def __call__(self, msa):
+        h = nn.LayerNorm(name="layer_norm")(msa)
+        h = nn.Dense(self.embed_dim * self.widening, kernel_init=bert_init,
+                     name="fc1")(h)
+        h = nn.gelu(h)
+        return nn.Dense(self.embed_dim, kernel_init=bert_init, name="fc2")(h)
+
+
+class OuterProductMean(nn.Module):
+    """MSA -> pair communication (Algorithm 10): the masked mean over
+    sequences of the outer product of two low-rank projections, one
+    einsum on the MXU — [B,S,R,h] x [B,S,R,h] -> [B,R,R,h*h] -> C_z."""
+
+    pair_dim: int           # C_z
+    hidden_dim: int = 32
+
+    @nn.compact
+    def __call__(self, msa, msa_mask=None):
+        """msa: [B, S, R, C_m]; msa_mask: [B, S, R] -> [B, R, R, C_z]."""
+        m = nn.LayerNorm(name="layer_norm")(msa)
+        a = nn.Dense(self.hidden_dim, use_bias=False, kernel_init=bert_init,
+                     name="a_proj")(m)
+        b = nn.Dense(self.hidden_dim, use_bias=False, kernel_init=bert_init,
+                     name="b_proj")(m)
+        if msa_mask is not None:
+            w = msa_mask.astype(a.dtype)[..., None]
+            a = a * w
+            b = b * w
+            # per-(i, j) count of sequences valid at BOTH residues
+            norm = jnp.einsum(
+                "bsi,bsj->bij", msa_mask.astype(jnp.float32),
+                msa_mask.astype(jnp.float32),
+            )[..., None]
+        else:
+            norm = jnp.asarray(float(msa.shape[1]), dtype=jnp.float32)
+        outer = jnp.einsum("bsic,bsjd->bijcd", a, b)
+        outer = outer.reshape(outer.shape[:3] + (-1,))
+        outer = outer / jnp.maximum(norm, 1e-3)
+        return nn.Dense(self.pair_dim, kernel_init=bert_init,
+                        name="out_proj")(outer)
+
+
+class EvoformerBlock(nn.Module):
+    """One full Evoformer block: the MSA half (row attention with pair
+    bias -> column attention -> transition), the outer-product-mean
+    communication into the pair representation, then the pair half
+    (:class:`~unicore_tpu.modules.triangle_attention.EvoformerPairBlock`:
+    triangle multiplicative updates, triangle attention, transition).
+    Returns the updated ``(msa, z)``."""
+
+    msa_dim: int
+    pair_dim: int
+    msa_heads: int = 8
+    pair_heads: int = 4
+    dropout: float = 0.0
+    opm_hidden_dim: int = 32
+    use_triangle_multiplication: bool = True
+
+    @nn.compact
+    def __call__(self, msa, z, msa_mask=None, pair_mask=None,
+                 deterministic: bool = True):
+        from .triangle_attention import EvoformerPairBlock
+
+        msa = msa + MSARowAttentionWithPairBias(
+            self.msa_dim, self.msa_heads, dropout=self.dropout,
+            name="row_attn",
+        )(msa, z, msa_mask, deterministic)
+        msa = msa + MSAColumnAttention(
+            self.msa_dim, self.msa_heads, dropout=self.dropout,
+            name="col_attn",
+        )(msa, msa_mask, deterministic)
+        msa = msa + MSATransition(self.msa_dim, name="msa_transition")(msa)
+
+        z = z + OuterProductMean(
+            self.pair_dim, hidden_dim=self.opm_hidden_dim,
+            name="outer_product_mean",
+        )(msa, msa_mask)
+
+        z = EvoformerPairBlock(
+            self.pair_dim, self.pair_heads, dropout=self.dropout,
+            use_triangle_multiplication=self.use_triangle_multiplication,
+            name="pair_block",
+        )(z, pair_mask, deterministic)
+        return msa, z
