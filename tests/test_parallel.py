@@ -143,31 +143,99 @@ def test_ulysses_key_padding_mask_headdim1_bias(rng, mesh, qkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_seq_parallel_attention_dropout_fails_fast(rng, mesh, qkv):
-    """attention_dropout > 0 under sequence parallelism is an error unless
-    the dropout skip is explicitly accepted (advisor r2: silent
-    regularization loss must not scroll by as a one-line warning)."""
+# ---------------------------------------------------------------------------
+# attention dropout on the sequence-parallel paths (VERDICT r3 next-5):
+# ring derives masks from global block identity, Ulysses decorrelates per
+# head-shard device — the escape hatch is retired
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_seq_parallel_dropout_statistics(rng, mesh, qkv, impl):
+    """With v = ones, dropout(softmax) rows sum to ~1 in expectation (the
+    1/(1-p) rescale is exact in the mean); p=0 reproduces the
+    deterministic path; the mask is deterministic per rng and changes
+    with it."""
+    from unicore_tpu.parallel import ring_self_attention, ulysses_self_attention
+
+    q, k, v = qkv
+    ones = jnp.ones_like(v)
+    attend = ring_self_attention if impl == "ring" else ulysses_self_attention
+    key = jax.random.PRNGKey(3)
+
+    out0 = attend(mesh, q, k, ones, dropout_p=0.0, rng=key)
+    ref = full_attention(q, k, ones)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(ref), atol=1e-5)
+
+    out1 = attend(mesh, q, k, ones, dropout_p=0.3, rng=key)
+    out1b = attend(mesh, q, k, ones, dropout_p=0.3, rng=key)
+    out2 = attend(mesh, q, k, ones, dropout_p=0.3, rng=jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out1b))
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    # expectation: every entry of out1 estimates 1 (row mass)
+    m = float(np.mean(np.asarray(out1)))
+    assert abs(m - 1.0) < 0.1, m
+    # and it is a real mask (row masses vary)
+    assert float(np.std(np.asarray(out1))) > 0.01
+
+
+def test_ulysses_dropout_decorrelates_head_shards(rng, mesh):
+    """All heads get IDENTICAL q/k/v; with per-device seed offsets the
+    sampled masks must still differ across head-shard devices (without
+    the offset, local head index 0 on every device would repeat the same
+    mask for different global heads)."""
+    from unicore_tpu.parallel import ulysses_self_attention
+
+    B, T, H, D = 2, 64, 8, 16
+    one_head = rng.randn(B, T, 1, D).astype(np.float32)
+    mk = lambda: jnp.asarray(np.repeat(one_head, H, axis=2))
+    q, k = mk(), mk()
+    ones = jnp.ones((B, T, H, D), jnp.float32)
+    out = ulysses_self_attention(
+        mesh, q, k, ones, dropout_p=0.4, rng=jax.random.PRNGKey(5)
+    )
+    out = np.asarray(out)  # [B, T, H, D]
+    for h in range(1, H):
+        assert not np.allclose(out[:, :, 0], out[:, :, h]), (
+            f"head {h} mask duplicates head 0's"
+        )
+
+
+def test_ring_dropout_grads_finite(rng, mesh, qkv):
+    from unicore_tpu.parallel import ring_self_attention
+
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        return jnp.sum(
+            ring_self_attention(
+                mesh, q, k, v, dropout_p=0.2, rng=jax.random.PRNGKey(0)
+            ) ** 2
+        )
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all()
+
+
+def test_module_seq_parallel_dropout_no_raise(rng, mesh, qkv):
+    """attention_dropout > 0 under sequence parallelism now WORKS (the
+    r2/r3 fail-fast + --seq-parallel-skip-attention-dropout hatch is
+    retired)."""
     from unicore_tpu import parallel
     from unicore_tpu.modules import multihead_attention as mha
 
     q, k, v = qkv
     devs = jax.devices()
-    mesh = jax.sharding.Mesh(
+    mesh3 = jax.sharding.Mesh(
         np.asarray(devs[:8]).reshape(1, 1, 8), ("data", "fsdp", "seq")
     )
-    parallel.enable_sequence_parallel(mesh, "ring")
+    parallel.enable_sequence_parallel(mesh3, "ring")
     try:
-        with pytest.raises(ValueError, match="attention_dropout"):
-            mha._seq_parallel_attend(
-                q, k, v, scaling=0.25, dropout=0.1,
-                key_padding_mask=None, bias=None,
-            )
-        # explicit opt-in: no raise, dropout skipped
-        parallel.enable_sequence_parallel(mesh, "ring", allow_dropout_skip=True)
         out = mha._seq_parallel_attend(
             q, k, v, scaling=0.25, dropout=0.1,
-            key_padding_mask=None, bias=None,
+            key_padding_mask=None, bias=None, rng=jax.random.PRNGKey(0),
         )
-        assert out is not None
+        assert out is not None and np.isfinite(np.asarray(out)).all()
     finally:
         parallel.disable_sequence_parallel()

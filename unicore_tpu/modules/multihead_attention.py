@@ -106,15 +106,16 @@ _warned_seq_parallel_dropout = [False]
 
 
 def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias,
-                         causal=False):
+                         causal=False, rng=None):
     """Sequence-parallel attention dispatch (mesh ``seq`` axis > 1).
 
     Returns None when the shapes don't fit the active scheme (sequence or
     batch not divisible by the mesh axes; self-attention only) — the
-    caller then falls back to local attention.  Attention dropout is NOT
-    applied on this path: the mask would have to be coordinated across the
-    k/v ring, and the reference has no sequence parallelism to set
-    semantics — hidden/FFN dropout still applies (warned once).
+    caller then falls back to local attention.  Attention dropout IS
+    implemented (since r4): ring derives per-(q-block, k-block) masks
+    from global block identity; Ulysses decorrelates per head-shard
+    device — ``--seq-parallel-skip-attention-dropout`` is retired (now a
+    deprecated no-op, warned once).
     """
     import logging
 
@@ -135,24 +136,14 @@ def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias,
     if impl == "ulysses" and h % n != 0:
         return None
 
-    if dropout > 0.0:
-        if not parallel.sequence_parallel_allows_dropout_skip():
-            # silent regularization loss is worse than a hard stop
-            # (advisor r2): make the user choose explicitly
-            raise ValueError(
-                f"sequence-parallel attention does not implement "
-                f"attention_dropout (={dropout:g}): dropout masks are not "
-                f"coordinated across the seq axis. Either set "
-                f"--attention-dropout 0 or pass "
-                f"--seq-parallel-skip-attention-dropout to accept "
-                f"training without it (hidden/FFN dropout still applies)."
-            )
+    if dropout > 0.0 and parallel.sequence_parallel_allows_dropout_skip():
         if not _warned_seq_parallel_dropout[0]:
             _warned_seq_parallel_dropout[0] = True
             logging.getLogger(__name__).warning(
-                "sequence-parallel attention skips attention_dropout=%g "
-                "(--seq-parallel-skip-attention-dropout); hidden/FFN "
-                "dropout still applies", dropout,
+                "--seq-parallel-skip-attention-dropout is deprecated and "
+                "ignored: sequence-parallel attention dropout is "
+                "implemented (ring: global-block-identity seeds; Ulysses: "
+                "per-device seed offsets)"
             )
 
     if key_padding_mask is not None:
@@ -173,6 +164,7 @@ def _seq_parallel_attend(q, k, v, scaling, dropout, key_padding_mask, bias,
     return attend(
         mesh, q, k, v, bias=bias, key_padding_mask=key_padding_mask,
         causal=causal, scale=scaling, batch_axes=batch_axes,
+        dropout_p=dropout, rng=rng,
     )
 
 
@@ -202,7 +194,7 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
     if not return_attn and q.shape[1] == k.shape[1]:
         sp_out = _seq_parallel_attend(
             q, k, v, scaling, dropout if not deterministic else 0.0,
-            key_padding_mask, bias, causal=causal,
+            key_padding_mask, bias, causal=causal, rng=rng,
         )
         if sp_out is not None:
             return sp_out

@@ -811,6 +811,7 @@ def flash_attention(
     is_training=True,
     scale=None,
     batch_seed_offset=None,
+    seed_offset=None,
 ):
     """Blockwise attention.  q/k/v: [B, T, H, D] (module layout); ``bias``
     broadcastable to [B, H, Tq, Tk]; ``key_padding_mask``: [B, Tk] with
@@ -820,7 +821,10 @@ def flash_attention(
     constant), so data-sharded invocations under one jit derive
     decorrelated masks.  ``batch_seed_offset`` lets an explicit-SPMD
     caller (shard_map) pass its shard's global row origin
-    (``axis_index * local_batch``)."""
+    (``axis_index * local_batch``); ``seed_offset`` is added to the BASE
+    seed — a head-sharded caller (Ulysses) passes a per-device offset so
+    the same local head index on different devices (= different global
+    heads) draws decorrelated masks."""
     bsz, tq, heads, d = q.shape
     if causal and tq != k.shape[1]:
         # the kernel's causal triangle compares GLOBAL q/k indices over one
@@ -843,6 +847,8 @@ def flash_attention(
         if rng is None:
             raise ValueError("flash_attention: rng required for dropout")
         base = jax.random.randint(rng, (), 0, 2 ** 31 - 1, dtype=jnp.int32)
+        if seed_offset is not None:
+            base = base + jnp.asarray(seed_offset, dtype=jnp.int32)
         rows = jax.lax.iota(jnp.int32, bsz)
         if batch_seed_offset is not None:
             rows = rows + jnp.asarray(batch_seed_offset, dtype=jnp.int32)
