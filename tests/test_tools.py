@@ -104,3 +104,222 @@ def test_profile_flag_captures_trace(tmp_path):
         found += [f for f in files if f.endswith((".xplane.pb",
                                                   ".trace.json.gz"))]
     assert found, f"no trace files under {trace_dir}"
+
+
+def test_bert_torch_bridge_forward_parity(tmp_path):
+    """VERDICT r3 next-6: a reference-format BERT torch checkpoint
+    converts with --arch bert into a tree our examples/bert model loads,
+    and the forward outputs match a torch oracle implementing the
+    reference semantics (examples/bert/model.py + transformer_encoder.py
+    + multihead_attention.py, post-LN, rel-pos bias, tied LM head)."""
+    torch = pytest.importorskip("torch")
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.modules import make_rp_bucket
+    from unicore_tpu.tools.convert_torch_checkpoint import convert
+
+    V, D, H, F_, L, T, PAD = 50, 32, 4, 64, 2, 16, 0
+    g = torch.Generator().manual_seed(0)
+
+    def rn(*shape):
+        return torch.randn(*shape, generator=g) * 0.1
+
+    sd = {
+        "embed_tokens.weight": rn(V, D),
+        "embed_positions.weight": rn(T, D),
+        "sentence_encoder.emb_layer_norm.weight": 1 + 0.1 * rn(D),
+        "sentence_encoder.emb_layer_norm.bias": rn(D),
+        "sentence_encoder.relative_attention_bias.weight": rn(32, H),
+    }
+    sd["embed_tokens.weight"][PAD] = 0.0
+    for i in range(L):
+        p = f"sentence_encoder.layers.{i}"
+        sd.update({
+            f"{p}.self_attn.in_proj.weight": rn(3 * D, D),
+            f"{p}.self_attn.in_proj.bias": rn(3 * D),
+            f"{p}.self_attn.out_proj.weight": rn(D, D),
+            f"{p}.self_attn.out_proj.bias": rn(D),
+            f"{p}.self_attn_layer_norm.weight": 1 + 0.1 * rn(D),
+            f"{p}.self_attn_layer_norm.bias": rn(D),
+            f"{p}.fc1.weight": rn(F_, D),
+            f"{p}.fc1.bias": rn(F_),
+            f"{p}.fc2.weight": rn(D, F_),
+            f"{p}.fc2.bias": rn(D),
+            f"{p}.final_layer_norm.weight": 1 + 0.1 * rn(D),
+            f"{p}.final_layer_norm.bias": rn(D),
+        })
+    sd.update({
+        "lm_head.dense.weight": rn(D, D),
+        "lm_head.dense.bias": rn(D),
+        "lm_head.layer_norm.weight": 1 + 0.1 * rn(D),
+        "lm_head.layer_norm.bias": rn(D),
+        "lm_head.weight": sd["embed_tokens.weight"],  # tied
+        "lm_head.bias": rn(V),
+    })
+
+    src = str(tmp_path / "ref_bert.pt")
+    dst = str(tmp_path / "bert_flax.pt")
+    torch.save({"model": sd, "extra_state": {}}, src)
+    convert(src, dst, arch="bert")
+
+    # ---- torch oracle: reference forward semantics -------------------
+    tokens = torch.randint(4, V, (2, T), generator=g)
+    tokens[:, T - 3:] = PAD  # padded tail
+    pad_mask = tokens.eq(PAD)
+
+    def t_ln(x, p):
+        return torch.nn.functional.layer_norm(
+            x, (x.shape[-1],), sd[p + ".weight"], sd[p + ".bias"]
+        )
+
+    x = sd["embed_tokens.weight"][tokens] + sd["embed_positions.weight"][:T]
+    x = t_ln(x, "sentence_encoder.emb_layer_norm")
+    x = x * (1 - pad_mask.unsqueeze(-1).float())
+    rp = torch.from_numpy(make_rp_bucket(T, 32, 128)).long()
+    bias = sd["sentence_encoder.relative_attention_bias.weight"][rp]
+    bias = bias.permute(2, 0, 1)[None].repeat(2, 1, 1, 1)  # [B, H, T, T]
+    bias = bias.masked_fill(pad_mask[:, None, None, :], float("-inf"))
+    for i in range(L):
+        p = f"sentence_encoder.layers.{i}"
+        qkv = x @ sd[f"{p}.self_attn.in_proj.weight"].T + sd[
+            f"{p}.self_attn.in_proj.bias"]
+        q, k, v = qkv.chunk(3, dim=-1)
+        mk = lambda t: t.view(2, T, H, D // H).transpose(1, 2)
+        q, k, v = mk(q) * (D // H) ** -0.5, mk(k), mk(v)
+        s = q @ k.transpose(-1, -2) + bias
+        a = torch.softmax(s, dim=-1)
+        o = (a @ v).transpose(1, 2).reshape(2, T, D)
+        o = o @ sd[f"{p}.self_attn.out_proj.weight"].T + sd[
+            f"{p}.self_attn.out_proj.bias"]
+        x = t_ln(x + o, f"{p}.self_attn_layer_norm")  # post-LN
+        h = torch.nn.functional.gelu(
+            x @ sd[f"{p}.fc1.weight"].T + sd[f"{p}.fc1.bias"]
+        )
+        h = h @ sd[f"{p}.fc2.weight"].T + sd[f"{p}.fc2.bias"]
+        x = t_ln(x + h, f"{p}.final_layer_norm")
+    h = torch.nn.functional.gelu(
+        x @ sd["lm_head.dense.weight"].T + sd["lm_head.dense.bias"]
+    )
+    h = t_ln(h, "lm_head.layer_norm")
+    want = h @ sd["lm_head.weight"].T + sd["lm_head.bias"]  # [B, T, V]
+
+    # ---- our model with the converted params -------------------------
+    from examples.bert.model import BertModel
+
+    with open(dst, "rb") as f:
+        conv = pickle.load(f)
+    params = jax.tree_util.tree_map(jnp.asarray, conv["model"]["params"])
+    model = BertModel(
+        vocab_size=V, padding_idx=PAD, encoder_layers=L,
+        encoder_embed_dim=D, encoder_ffn_embed_dim=F_,
+        encoder_attention_heads=H, max_seq_len=T, post_ln=True,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, masked_loss_capacity=0.0,
+    )
+    got = model.apply({"params": params}, jnp.asarray(tokens.numpy()))
+    got = np.asarray(got)
+
+    valid = ~pad_mask.numpy()  # padded queries are garbage in both
+    np.testing.assert_allclose(
+        got[valid], want.numpy()[valid], rtol=2e-3, atol=2e-3
+    )
+
+
+def test_bert_converted_checkpoint_finetunes(tmp_path):
+    """The converted checkpoint loads through the real restore path
+    (--finetune-from-model semantics: params only, fresh optimizer)."""
+    torch = pytest.importorskip("torch")
+    import jax
+    from argparse import Namespace
+
+    from unicore_tpu import metrics
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.losses.masked_lm import MaskedLMLoss
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.tools.convert_torch_checkpoint import convert
+    from unicore_tpu.trainer import Trainer
+
+    V, D, H, F_, L, T = 37, 16, 2, 32, 1, 8
+    g = torch.Generator().manual_seed(1)
+    sd = {
+        "embed_tokens.weight": torch.randn(V, D, generator=g),
+        "embed_positions.weight": torch.randn(T, D, generator=g),
+        "sentence_encoder.emb_layer_norm.weight": torch.ones(D),
+        "sentence_encoder.emb_layer_norm.bias": torch.zeros(D),
+        "sentence_encoder.relative_attention_bias.weight":
+            torch.randn(32, H, generator=g),
+        "sentence_encoder.layers.0.self_attn.in_proj.weight":
+            torch.randn(3 * D, D, generator=g),
+        "sentence_encoder.layers.0.self_attn.in_proj.bias":
+            torch.randn(3 * D, generator=g),
+        "sentence_encoder.layers.0.self_attn.out_proj.weight":
+            torch.randn(D, D, generator=g),
+        "sentence_encoder.layers.0.self_attn.out_proj.bias":
+            torch.randn(D, generator=g),
+        "sentence_encoder.layers.0.self_attn_layer_norm.weight": torch.ones(D),
+        "sentence_encoder.layers.0.self_attn_layer_norm.bias": torch.zeros(D),
+        "sentence_encoder.layers.0.fc1.weight": torch.randn(F_, D, generator=g),
+        "sentence_encoder.layers.0.fc1.bias": torch.randn(F_, generator=g),
+        "sentence_encoder.layers.0.fc2.weight": torch.randn(D, F_, generator=g),
+        "sentence_encoder.layers.0.fc2.bias": torch.randn(D, generator=g),
+        "sentence_encoder.layers.0.final_layer_norm.weight": torch.ones(D),
+        "sentence_encoder.layers.0.final_layer_norm.bias": torch.zeros(D),
+        "lm_head.dense.weight": torch.randn(D, D, generator=g),
+        "lm_head.dense.bias": torch.randn(D, generator=g),
+        "lm_head.layer_norm.weight": torch.ones(D),
+        "lm_head.layer_norm.bias": torch.zeros(D),
+        "lm_head.bias": torch.zeros(V),
+    }
+    src, dst = str(tmp_path / "r.pt"), str(tmp_path / "c.pt")
+    torch.save({"model": sd}, src)
+    convert(src, dst, arch="bert")
+
+    from examples.bert.model import BertModel
+
+    d = Dictionary()
+    for i in range(V - 5):
+        d.add_symbol(f"t{i}")
+    d.add_symbol("[MASK]", is_special=True)
+    assert len(d) == V
+    args = Namespace(
+        seed=1, update_freq=[1], clip_norm=0.0, ema_decay=-1.0,
+        fp16=False, bf16=False, bf16_sr=False,
+        optimizer="adam", lr=[1e-3], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=10, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+
+    class _Task(UnicoreTask):
+        def __init__(self, a):
+            super().__init__(a)
+            self.dictionary = d
+
+    task = _Task(args)
+    model = BertModel(
+        vocab_size=V, padding_idx=d.pad(), encoder_layers=L,
+        encoder_embed_dim=D, encoder_ffn_embed_dim=F_,
+        encoder_attention_heads=H, max_seq_len=T, post_ln=True,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0,
+    )
+    trainer = Trainer(args, task, model, MaskedLMLoss(task))
+    trainer.load_checkpoint(dst, reset_optimizer=True)
+    toks = np.full((4, T), 4, dtype=np.int64)
+    batch = {"net_input": {"src_tokens": toks},
+             "target": np.full_like(toks, d.pad())}
+    trainer.init_state(batch)
+    got = np.asarray(
+        jax.device_get(trainer.state["params"]["embed_tokens"]["embedding"])
+    )
+    np.testing.assert_allclose(got, sd["embed_tokens.weight"].numpy(),
+                               rtol=1e-6)
+    # and it can step
+    metrics.reset()
+    batch["target"][:, 0] = toks[:, 0]
+    with metrics.aggregate("train"):
+        logs = trainer.train_step([batch])
+    assert np.isfinite(float(logs[0]["loss"]))

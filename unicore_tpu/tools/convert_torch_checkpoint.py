@@ -3,25 +3,157 @@
 Usage::
 
     python -m unicore_tpu.tools.convert_torch_checkpoint in.pt out.pt \
-        [--param-map map.json]
+        [--arch bert] [--param-map map.json]
 
 Reads the torch checkpoint (zipfile or legacy pickle; reference layout
 ``{"model": state_dict, "args": ..., "extra_state": ...}``,
-``unicore/trainer.py:299-325``) on CPU, converts every tensor to numpy,
-and writes a pickled numpy tree.  Model-parameter NAMES are framework
-specific (torch modules vs flax collections), so the output stores the
-flat numpy state dict under ``"torch_model"`` for a model-specific loader
-to consume, optionally pre-renamed via ``--param-map`` (a JSON dict of
-``torch_name -> new_name``).
+``unicore/trainer.py:299-325``) on CPU and converts every tensor to numpy.
+
+With ``--arch bert`` the flat torch state dict is restructured into this
+framework's nested flax tree (reference ``examples/bert/model.py:18-260``
+names -> the ``examples/bert`` flax module tree, transposing Linear
+weights and folding the fused QKV into the [D, 3, H, Dh] DenseGeneral
+kernel), and the output is a DIRECTLY LOADABLE checkpoint::
+
+    unicore-train DATA ... --finetune-from-model out.pt
+
+Without ``--arch``, the flat numpy dict is stored under ``"torch_model"``
+for a model-specific loader, optionally pre-renamed via ``--param-map``
+(a JSON dict of ``torch_name -> new_name``).
 """
 
 import argparse
 import json
+import logging
 import pickle
+import re
 import sys
 
+logger = logging.getLogger(__name__)
 
-def convert(in_path, out_path, param_map=None):
+
+def _t(w):
+    """torch Linear stores [out, in]; flax Dense kernels are [in, out]."""
+    return w.T.copy()
+
+
+def bert_flax_params(flat, heads=None):
+    """Reference examples/bert BertModel state_dict -> flax params tree.
+
+    ``flat``: {torch param name: np.ndarray}.  ``heads`` is inferred from
+    ``sentence_encoder.relative_attention_bias.weight`` ([buckets, H])
+    when not given.  Returns (params_tree, unused_keys)."""
+    import numpy as np
+
+    if heads is None:
+        rb = flat.get("sentence_encoder.relative_attention_bias.weight")
+        if rb is None:
+            raise ValueError(
+                "cannot infer --heads: checkpoint has no "
+                "relative_attention_bias (pass --heads explicitly)"
+            )
+        heads = int(rb.shape[1])
+
+    used = set()
+
+    def take(name):
+        used.add(name)
+        return np.asarray(flat[name])
+
+    def layer_norm(prefix):
+        return {"weight": take(prefix + ".weight"),
+                "bias": take(prefix + ".bias")}
+
+    def dense(prefix):
+        return {"kernel": _t(take(prefix + ".weight")),
+                "bias": take(prefix + ".bias")}
+
+    params = {
+        "embed_tokens": {"embedding": take("embed_tokens.weight")},
+        "embed_positions": take("embed_positions.weight"),
+    }
+
+    enc = {
+        "emb_layer_norm": layer_norm("sentence_encoder.emb_layer_norm"),
+    }
+    if "sentence_encoder.final_layer_norm.weight" in flat:
+        enc["final_layer_norm"] = layer_norm(
+            "sentence_encoder.final_layer_norm"
+        )
+    if "sentence_encoder.relative_attention_bias.weight" in flat:
+        enc["relative_attention_bias"] = {
+            "weight": take("sentence_encoder.relative_attention_bias.weight")
+        }
+
+    layer_ids = [
+        int(m.group(1))
+        for m in (re.match(r"sentence_encoder\.layers\.(\d+)\.", k)
+                  for k in flat)
+        if m
+    ]
+    if not layer_ids:
+        raise ValueError(
+            "checkpoint has no sentence_encoder.layers.* tensors — not a "
+            "reference examples/bert BertModel state dict (wrong --arch?)"
+        )
+    n_layers = 1 + max(layer_ids)
+    for i in range(n_layers):
+        p = f"sentence_encoder.layers.{i}"
+        # fused QKV: torch [3D, D] row-blocks q|k|v -> transpose to
+        # [D, 3D] (q = first D columns, matching chunk(3, dim=-1)) ->
+        # DenseGeneral kernel [D, 3, H, Dh]
+        w = _t(take(f"{p}.self_attn.in_proj.weight"))
+        d = w.shape[0]
+        head_dim = d // heads
+        enc[f"layers_{i}"] = {
+            "self_attn": {
+                "in_proj": {
+                    "kernel": w.reshape(d, 3, heads, head_dim),
+                    "bias": take(f"{p}.self_attn.in_proj.bias").reshape(
+                        3, heads, head_dim
+                    ),
+                },
+                "out_proj": dense(f"{p}.self_attn.out_proj"),
+            },
+            "self_attn_layer_norm": layer_norm(f"{p}.self_attn_layer_norm"),
+            "fc1": dense(f"{p}.fc1"),
+            "fc2": dense(f"{p}.fc2"),
+            "final_layer_norm": layer_norm(f"{p}.final_layer_norm"),
+        }
+    params["sentence_encoder"] = enc
+
+    if "lm_head.dense.weight" in flat:
+        params["lm_head"] = {
+            "dense": dense("lm_head.dense"),
+            "layer_norm": layer_norm("lm_head.layer_norm"),
+            "bias": take("lm_head.bias"),
+        }
+        if "lm_head.weight" in flat:
+            used.add("lm_head.weight")
+            if not np.allclose(flat["lm_head.weight"],
+                               flat["embed_tokens.weight"]):
+                logger.warning(
+                    "lm_head.weight is NOT tied to embed_tokens.weight in "
+                    "the source checkpoint; this framework's BertLMHead is "
+                    "always tied — the untied projection is dropped"
+                )
+
+    for k in flat:
+        m = re.match(r"classification_heads\.([^.]+)\.(dense|out_proj)\.", k)
+        if m:
+            name, sub = m.group(1), m.group(2)
+            head = params.setdefault(f"classification_heads_{name}", {})
+            if sub not in head:
+                head[sub] = dense(f"classification_heads.{name}.{sub}")
+
+    unused = sorted(set(flat) - used)
+    return params, unused
+
+
+ARCH_CONVERTERS = {"bert": bert_flax_params}
+
+
+def convert(in_path, out_path, param_map=None, arch=None, heads=None):
     try:
         import torch
     except ImportError:
@@ -38,18 +170,37 @@ def convert(in_path, out_path, param_map=None):
             value = value.float().numpy() if value.dtype.is_floating_point \
                 else value.numpy()
         flat[name] = np.asarray(value)
-    out = {
-        "torch_model": flat,
-        "extra_state": {
-            k: v for k, v in state.get("extra_state", {}).items()
-            if isinstance(v, (int, float, str, bool, type(None)))
-        },
-        "source": in_path,
-        "format": "unicore_tpu/torch-import/v1",
+    extra = {
+        k: v for k, v in state.get("extra_state", {}).items()
+        if isinstance(v, (int, float, str, bool, type(None)))
     }
+    if arch is not None:
+        params, unused = ARCH_CONVERTERS[arch](flat, heads=heads)
+        if unused:
+            print(f"note: {len(unused)} source tensors unused: "
+                  f"{unused[:8]}{'...' if len(unused) > 8 else ''}")
+        out = {
+            "model": {
+                "step": np.zeros((), dtype=np.int32),
+                "params": params,
+            },
+            "optimizer_history": [{"num_updates": 0}],
+            "extra_state": extra,
+            "source": in_path,
+            "format": f"unicore_tpu/{arch}/v1",
+        }
+    else:
+        out = {
+            "torch_model": flat,
+            "extra_state": extra,
+            "source": in_path,
+            "format": "unicore_tpu/torch-import/v1",
+        }
     with open(out_path, "wb") as f:
         pickle.dump(out, f, protocol=4)
-    print(f"wrote {out_path}: {len(flat)} tensors")
+    print(f"wrote {out_path}: {len(flat)} tensors"
+          + (f" (arch={arch}, loadable via --finetune-from-model)"
+             if arch else ""))
 
 
 def main(argv=None):
@@ -58,12 +209,19 @@ def main(argv=None):
     p.add_argument("output")
     p.add_argument("--param-map", default=None,
                    help="JSON file mapping torch param names to new names")
+    p.add_argument("--arch", default=None, choices=sorted(ARCH_CONVERTERS),
+                   help="restructure into this framework's flax tree for "
+                        "the named example architecture (directly loadable "
+                        "via --finetune-from-model)")
+    p.add_argument("--heads", type=int, default=None,
+                   help="attention heads (inferred from the rel-pos bias "
+                        "table when omitted)")
     a = p.parse_args(argv)
     pm = None
     if a.param_map:
         with open(a.param_map) as f:
             pm = json.load(f)
-    convert(a.input, a.output, pm)
+    convert(a.input, a.output, pm, arch=a.arch, heads=a.heads)
 
 
 if __name__ == "__main__":
