@@ -82,6 +82,14 @@ def _map_host_arrays(fn, tree):
     )
 
 
+def _looks_like_oom(e):
+    """Allocator failures surface as XlaRuntimeError RESOURCE_EXHAUSTED."""
+    text = f"{type(e).__name__}: {e}"
+    return any(tag in text for tag in
+               ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "Resource exhausted", "OOM"))
+
+
 def _tree_has_markers(tree):
     import jax as _j
 
@@ -200,6 +208,9 @@ class Trainer:
         self._num_updates = 0
         self._dummy_batch = None
         self._jit_train_step = None
+        self._compiled_train_step = None
+        self._compiled_sig = None
+        self._memory_analysis = None
         self._jit_valid_step = None
         self.total_train_steps = None
         # pipelined stats: keep up to ``stats_lag`` steps' device stats
@@ -696,6 +707,8 @@ class Trainer:
         batches, weights_np = self._stack_microbatches(samples)
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
+            self._compiled_train_step = None
+            self._compiled_sig = None
             self._logging_proto_cached = None
 
         if self._dispatch_count is None:
@@ -721,13 +734,16 @@ class Trainer:
         self._dispatch_count += 1
         try:
             with jax.profiler.TraceAnnotation("train_step/dispatch"):
-                self.state, stats = self._jit_train_step(
+                self.state, stats = self._dispatch_train_step(
                     self.state, batches, jnp.asarray(weights_np), lr, rng
                 )
-        except Exception:
+        except Exception as e:
             # the reference logs cuda memory_summary on step failure
-            # (trainer.py:639-654); HBM stats are the TPU analogue
+            # (trainer.py:639-654); HBM stats are the TPU analogue, plus
+            # the compile-time per-buffer breakdown and concrete knobs
             self.log_memory_stats(level=logging.ERROR)
+            if _looks_like_oom(e):
+                logger.error(self._oom_guidance())
             raise
 
         mem_every = int(getattr(self.args, "log_memory", 0) or 0)
@@ -744,6 +760,84 @@ class Trainer:
         while len(self._pending_stats) > self.stats_lag:
             out = self._process_stats(*self._pending_stats.pop(0))
         return out
+
+    def _dispatch_train_step(self, state, batches, weights, lr, rng):
+        """AOT-compile the train step (so its ``memory_analysis()`` can be
+        checked against HBM BEFORE the first step executes — the §5.3
+        ergonomics the reference's OOM catch-log-retry provided,
+        trainer.py:639-654) and dispatch through the compiled object.
+        Recompiles if the batch signature changes (jit semantics)."""
+        sig = tuple(
+            (tuple(x.shape), str(getattr(x, "dtype", type(x))))
+            for x in jax.tree_util.tree_leaves((batches, weights))
+        )
+        if self._compiled_train_step is None or self._compiled_sig != sig:
+            lowered = self._jit_train_step.lower(
+                state, batches, weights, lr, rng
+            )
+            with jax.profiler.TraceAnnotation("train_step/compile"):
+                compiled = lowered.compile()
+            self._preflight_memory_check(compiled)
+            self._compiled_train_step = compiled
+            self._compiled_sig = sig
+        return self._compiled_train_step(state, batches, weights, lr, rng)
+
+    def _preflight_memory_check(self, compiled):
+        """Compare the compiled step's memory footprint against device HBM
+        and warn with per-buffer numbers + knobs before anything runs."""
+        try:
+            ma = compiled.memory_analysis()
+            est = int(
+                ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes - ma.alias_size_in_bytes
+            )
+            self._memory_analysis = {
+                "arguments_gb": ma.argument_size_in_bytes / 1e9,
+                "outputs_gb": ma.output_size_in_bytes / 1e9,
+                "temporaries_gb": ma.temp_size_in_bytes / 1e9,
+                "aliased_gb": ma.alias_size_in_bytes / 1e9,
+                "estimated_peak_gb": est / 1e9,
+            }
+        except Exception:  # backend without memory analysis
+            return
+        ms = self._device_memory_stats() or {}
+        limit = ms.get("bytes_limit")
+        breakdown = ", ".join(
+            f"{k}={v:.2f}" for k, v in self._memory_analysis.items()
+        )
+        if limit and est > 0.95 * limit:
+            logger.error(
+                "train step memory estimate %.2f GB exceeds ~%.2f GB of "
+                "device HBM — it will likely OOM. Breakdown (GB): %s. %s",
+                est / 1e9, limit / 1e9, breakdown, self._oom_guidance(),
+            )
+        else:
+            logger.info("train step memory (GB): %s%s", breakdown,
+                        f" (HBM limit {limit / 1e9:.2f})" if limit else "")
+
+    def _oom_guidance(self):
+        """Concrete knobs, most effective first (the §5.3 ergonomics the
+        allocator's raw RESOURCE_EXHAUSTED dump lacks)."""
+        ma = getattr(self, "_memory_analysis", None)
+        detail = (
+            " Compile-time breakdown (GB): "
+            + ", ".join(f"{k}={v:.2f}" for k, v in ma.items())
+            if ma else ""
+        )
+        return (
+            "Out-of-memory mitigation knobs: "
+            "(1) lower --batch-size and raise --update-freq to keep the "
+            "global batch (grad accumulation trades HBM for steps); "
+            "(2) --checkpoint-activations rematerializes layer "
+            "activations in backward; "
+            "(3) long sequences: --rel-pos False (drop the quadratic "
+            "[1,H,T,T] bias; add --rotary True for relative positions) "
+            "keeps attention memory O(T) via the flash kernel; "
+            "(4) --fsdp-size N shards optimizer state + master params "
+            "(ZeRO); "
+            "(5) BERT-style masked LM: lower --masked-loss-capacity to "
+            "shrink the LM-head slot buffer." + detail
+        )
 
     def _device_memory_stats(self):
         try:
