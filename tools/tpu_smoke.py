@@ -104,6 +104,25 @@ def _smoke_evoformer_full():
     jax.block_until_ready(g)
 
 
+def _smoke_structure_module():
+    """Structure-module representative (IPA + backbone update) runs
+    fwd+bwd on the chip — the second half of the Uni-Fold workload
+    (BASELINE configs[2])."""
+    from unicore_tpu.modules import StructureModule
+
+    mod = StructureModule(embed_dim=128, num_heads=8, n_layers=4)
+    s = jnp.zeros((1, 128, 128), jnp.float32)
+    z = jnp.zeros((1, 128, 128, 128), jnp.float32)
+    params = jax.jit(mod.init)(jax.random.PRNGKey(0), s, z)["params"]
+
+    def f(p):
+        s_out, _, pos = mod.apply({"params": p}, s, z)
+        return jnp.sum(pos ** 2) + jnp.sum(s_out ** 2)
+
+    g = jax.jit(jax.grad(f))(params)
+    jax.block_until_ready(g)
+
+
 def main():
     backend = jax.default_backend()
     print(f"backend: {backend} ({jax.devices()[0].device_kind})")
@@ -120,6 +139,7 @@ def main():
         ("fp32_to_bf16_sr", _smoke_rounding),
         ("evoformer_pair_block", _smoke_evoformer),
         ("evoformer_full_block", _smoke_evoformer_full),
+        ("structure_module", _smoke_structure_module),
     ]:
         try:
             fn()

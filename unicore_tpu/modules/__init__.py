@@ -38,3 +38,9 @@ from .msa_attention import (  # noqa: F401
     MSATransition,
     OuterProductMean,
 )
+from .structure_module import (  # noqa: F401
+    BackboneUpdate,
+    InvariantPointAttention,
+    StructureModule,
+    StructureModuleLayer,
+)
