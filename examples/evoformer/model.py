@@ -16,7 +16,7 @@ from unicore_tpu.models import (
     register_model,
     register_model_architecture,
 )
-from unicore_tpu.modules import EvoformerBlock, bert_init
+from unicore_tpu.modules import EvoformerBlock, StructureModule, bert_init
 from unicore_tpu.utils import eval_bool
 
 
@@ -30,6 +30,8 @@ class EvoformerModel(BaseUnicoreModel):
     opm_hidden_dim: int = 16
     dropout: float = 0.0
     triangle_multiplication: bool = True
+    structure_module: bool = False
+    structure_layers: int = 3
 
     @staticmethod
     def add_args(parser):
@@ -42,6 +44,13 @@ class EvoformerModel(BaseUnicoreModel):
         parser.add_argument("--dropout", type=float, metavar="D")
         # NOT type=bool: bool("False") is True — eval_bool parses the text
         parser.add_argument("--triangle-multiplication", type=eval_bool)
+        parser.add_argument("--structure-module", type=eval_bool,
+                            help="predict distances GEOMETRICALLY: run the "
+                                 "structure module (IPA + backbone update) "
+                                 "on the refined single/pair reprs and "
+                                 "output pairwise distances of the "
+                                 "predicted C-alpha trace")
+        parser.add_argument("--structure-layers", type=int, metavar="N")
 
     @classmethod
     def build_model(cls, args, task):
@@ -58,6 +67,8 @@ class EvoformerModel(BaseUnicoreModel):
             opm_hidden_dim=arg("opm_hidden_dim", 16),
             dropout=arg("dropout", 0.0),
             triangle_multiplication=arg("triangle_multiplication", True),
+            structure_module=bool(arg("structure_module", False)),
+            structure_layers=arg("structure_layers", 3),
         )
 
     @nn.compact
@@ -79,6 +90,22 @@ class EvoformerModel(BaseUnicoreModel):
                 use_triangle_multiplication=self.triangle_multiplication,
                 name=f"blocks_{i}",
             )(m, z, msa_mask, pair_mask, deterministic)
+        if self.structure_module:
+            # the AlphaFold wiring: single repr = first MSA row; the
+            # structure module folds the pair repr into frames; the
+            # output distances are GEOMETRIC — pairwise norms of the
+            # predicted C-alpha trace, so the loss trains IPA + backbone
+            # update end-to-end through real 3-D structure
+            single = m[:, 0]
+            res_mask = None if msa_mask is None else msa_mask[:, 0]
+            _, _, pos = StructureModule(
+                embed_dim=self.msa_embed_dim,
+                num_heads=self.msa_attention_heads,
+                n_layers=self.structure_layers,
+                name="structure_module",
+            )(single, z, res_mask)
+            diff = pos[:, :, None, :] - pos[:, None, :, :]
+            return jnp.sqrt(jnp.sum(diff ** 2, axis=-1) + 1e-8)
         z = nn.LayerNorm(name="final_norm")(z)
         out = nn.Dense(1, kernel_init=bert_init, name="head")(z)[..., 0]
         # distances are symmetric; average the two directed predictions

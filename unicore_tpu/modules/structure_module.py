@@ -146,7 +146,10 @@ class InvariantPointAttention(nn.Module):
         o_pair = jnp.einsum("bhqk,bqkc->bqhc", att, z).reshape(bsz, n_res, -1)
         op_g = jnp.einsum("bhqk,bkhpx->bqhpx", att, vp_g)
         op_l = rigid_invert_apply(rot, trans, op_g)  # back to local frames
-        op_norm = jnp.linalg.norm(op_l + 1e-8, axis=-1)
+        # eps under the sqrt: norm(v + eps) merely SHIFTS the 0/0 gradient
+        # singularity (and biases the feature along (1,1,1)); sum-sq + eps
+        # removes it
+        op_norm = jnp.sqrt(jnp.sum(op_l ** 2, axis=-1) + 1e-8)
         out = jnp.concatenate(
             [o, o_pair, op_l.reshape(bsz, n_res, -1),
              op_norm.reshape(bsz, n_res, -1)], axis=-1,
@@ -158,11 +161,19 @@ class InvariantPointAttention(nn.Module):
 
 class BackboneUpdate(nn.Module):
     """Alg. 23: predict a (quaternion, translation) update per residue
-    from the single representation and compose it onto the frames."""
+    from the single representation and compose it onto the frames.
+
+    The update projection uses a SMALL random init, not zeros: with a
+    zero init every residue sits at the origin, all pairwise distances
+    are identically zero, and d sqrt(|dx|^2 + eps)/d dx = 0 there — a
+    saddle where distance-based losses have exactly zero gradient into
+    the entire network (observed as gnorm 0, training frozen)."""
 
     @nn.compact
     def __call__(self, s, rot, trans):
-        upd = nn.Dense(6, kernel_init=nn.initializers.zeros, name="update")(s)
+        upd = nn.Dense(
+            6, kernel_init=nn.initializers.normal(stddev=0.02), name="update"
+        )(s)
         bcd, t_upd = upd[..., :3], upd[..., 3:]
         quat = jnp.concatenate(
             [jnp.ones_like(bcd[..., :1]), bcd], axis=-1
