@@ -1,0 +1,93 @@
+"""Autoregressive generation for the LM example via the KV-cache decode
+path (capability beyond the reference, which is a trainer only: SURVEY
+notes no generation surface anywhere).
+
+One jit-compiled step is reused for every position: the cache (flax
+"cache" collection: per-layer cached_key/cached_value/cache_index) is
+threaded functionally, positions drive RoPE/absolute embeddings, and the
+prompt prefills in a single call before single-token steps.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, batch_size, max_len):
+    """Allocate a decode cache with capacity ``max_len``: shapes come
+    from ``eval_shape`` over init (zero FLOPs — a real init would run a
+    full O(max_len^2) forward just to read back zero buffers)."""
+    proto = jnp.zeros((batch_size, max_len), jnp.int32)
+    # decode must stay a PYTHON bool (it drives trace-time control flow),
+    # so close over it rather than passing it through eval_shape
+    shapes = jax.eval_shape(
+        lambda key, p: model.init(key, p, decode=True),
+        jax.random.PRNGKey(0), proto,
+    )["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill(model, params, cache, prompt):
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        positions=jnp.arange(prompt.shape[1]), mutable=["cache"],
+    )
+    return logits[:, -1], mutated["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _step(model, params, cache, token, t):
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, token[:, None], decode=True,
+        positions=t[None], mutable=["cache"],
+    )
+    return logits[:, -1], mutated["cache"]
+
+
+def generate(model, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None, max_len=None):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T0].
+
+    ``temperature`` 0 = greedy; otherwise softmax sampling (requires
+    ``rng``).  Returns int32 [B, T0 + max_new_tokens]."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    bsz, t0 = prompt.shape
+    capacity = max_len or model.max_seq_len
+    assert t0 + max_new_tokens <= capacity, (
+        f"prompt ({t0}) + new tokens ({max_new_tokens}) exceeds cache "
+        f"capacity ({capacity})"
+    )
+    if bool((prompt == model.padding_idx).any()):
+        raise ValueError(
+            "generate: prompts must not contain padding tokens (pad k/v "
+            "would enter the cache and be attended by every later step); "
+            "generate ragged batches prompt-by-prompt"
+        )
+    cache = init_cache(model, bsz, capacity)
+    logit, cache = _prefill(model, params, cache, prompt)
+
+    def pick(logit, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logit, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logit.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    if temperature > 0.0 and rng is None:
+        raise ValueError("generate: rng required when temperature > 0")
+    out = [prompt]
+    for i in range(max_new_tokens):
+        key = None
+        if temperature > 0.0:
+            rng, key = jax.random.split(rng)
+        tok = pick(logit, key)
+        out.append(tok[:, None])
+        if i + 1 < max_new_tokens:
+            logit, cache = _step(
+                model, params, cache, tok, jnp.asarray(t0 + i, jnp.int32)
+            )
+    return jnp.concatenate(out, axis=1)

@@ -144,7 +144,10 @@ class TransformerLMModel(BaseUnicoreModel):
         return cls._off_when_rotary(args, "rel-pos")
 
     @nn.compact
-    def __call__(self, src_tokens, deterministic=True, **kwargs):
+    def __call__(self, src_tokens, deterministic=True, decode=False,
+                 positions=None, **kwargs):
+        # decoding assumes unpadded prompts (generate() enforces); the
+        # decoder drops the key-padding mask on the decode path itself
         padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
         embed = nn.Embed(
             self.vocab_size,
@@ -158,7 +161,10 @@ class TransformerLMModel(BaseUnicoreModel):
                 "embed_positions", bert_init,
                 (self.max_seq_len, self.decoder_embed_dim), jnp.float32,
             )
-            x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
+            if positions is None:
+                x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
+            else:
+                x = x + jnp.take(pos, positions, axis=0).astype(x.dtype)
 
         x = TransformerDecoder(
             decoder_layers=self.decoder_layers,
@@ -177,7 +183,8 @@ class TransformerLMModel(BaseUnicoreModel):
             checkpoint_activations=self.checkpoint_activations,
             auto_regressive=True,
             name="decoder",
-        )(x, padding_mask=padding_mask, deterministic=deterministic)
+        )(x, padding_mask=padding_mask, deterministic=deterministic,
+          decode=decode, positions=positions)
 
         # tied projection + final LN'd features -> logits
         x = LayerNorm(self.decoder_embed_dim, name="out_layer_norm")(x)

@@ -1,0 +1,136 @@
+"""KV-cache incremental decoding: the load-bearing property is
+teacher-forcing CONSISTENCY — stepping tokens one at a time through the
+cache must reproduce the full-sequence forward logits exactly (same
+params, same tokens), for both RoPE and absolute-position models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from examples.lm.model import TransformerLMModel
+
+V, D, H, F, L, T = 29, 32, 4, 64, 2, 12
+PAD = 0
+
+
+def make_model(**over):
+    kw = dict(
+        vocab_size=V, padding_idx=PAD, decoder_layers=L,
+        decoder_embed_dim=D, decoder_ffn_embed_dim=F,
+        decoder_attention_heads=H, max_seq_len=T + 8,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, rel_pos=False, abs_pos=True, rotary=False,
+    )
+    kw.update(over)
+    return TransformerLMModel(**kw)
+
+
+@pytest.mark.parametrize("variant", ["abs_pos", "rotary"])
+def test_incremental_decode_matches_full_forward(rng, variant):
+    model = make_model(
+        abs_pos=variant == "abs_pos", rotary=variant == "rotary"
+    )
+    toks = jnp.asarray(rng.randint(1, V, size=(2, T)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    full = model.apply({"params": params}, toks)  # [B, T, V]
+
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, T), jnp.int32), decode=True
+    )["cache"]
+    got = []
+    for t in range(T):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, toks[:, t: t + 1],
+            decode=True, positions=jnp.asarray([t]), mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        got.append(logits[:, 0])
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_prefill_then_steps_matches_full(rng):
+    """Mixed mode: multi-token prefill, then single-token steps."""
+    model = make_model()
+    toks = jnp.asarray(rng.randint(1, V, size=(2, T)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    full = model.apply({"params": params}, toks)
+
+    split = 7
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, T), jnp.int32), decode=True
+    )["cache"]
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, toks[:, :split], decode=True,
+        positions=jnp.arange(split), mutable=["cache"],
+    )
+    cache = mutated["cache"]
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :split]), atol=2e-4, rtol=2e-4
+    )
+    for t in range(split, T):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, toks[:, t: t + 1],
+            decode=True, positions=jnp.asarray([t]), mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+            atol=2e-4, rtol=2e-4,
+        )
+
+
+def test_generate_greedy_matches_step_by_step_forward(rng):
+    """generate() must produce exactly the tokens a naive full-forward
+    greedy loop produces (the expensive O(T^2)-per-token oracle)."""
+    from examples.lm.generate import generate
+
+    model = make_model(rotary=True, abs_pos=False)
+    prompt = jnp.asarray(rng.randint(1, V, size=(2, 4)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    n_new = 6
+    out = generate(model, params, prompt, n_new)
+    assert out.shape == (2, 4 + n_new)
+
+    toks = prompt
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_decode_with_rel_pos_fails_fast(rng):
+    model = make_model(rel_pos=True)
+    toks = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(NotImplementedError, match="rel_pos"):
+        model.init(jax.random.PRNGKey(0), toks, decode=True)
+
+
+def test_generate_rejects_padded_prompts(rng):
+    from examples.lm.generate import generate
+
+    model = make_model()
+    prompt = jnp.asarray([[PAD, 3, 4]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="padding"):
+        generate(model, params, prompt, 2)
+
+
+def test_decode_rejects_bias_and_missing_positions(rng):
+    from unicore_tpu.modules import SelfMultiheadAttention
+
+    attn = SelfMultiheadAttention(embed_dim=D, num_heads=H, dropout=0.0,
+                                  rotary=True)
+    x = jnp.asarray(rng.randn(1, 4, D).astype(np.float32))
+    variables = attn.init(jax.random.PRNGKey(0), x, decode=True)
+    with pytest.raises(ValueError, match="positions"):
+        attn.apply(variables, x[:, :1], decode=True, mutable=["cache"])
+    with pytest.raises(NotImplementedError, match="attn_bias"):
+        attn.apply(variables, x[:, :1], decode=True,
+                   positions=jnp.asarray([0]),
+                   attn_bias=jnp.zeros((1, H, 1, 4)), mutable=["cache"])

@@ -252,7 +252,17 @@ class SelfMultiheadAttention(nn.Module):
         return_attn: bool = False,
         deterministic: bool = True,
         causal: bool = False,
+        decode: bool = False,
+        positions: Optional[jnp.ndarray] = None,
     ):
+        """``decode=True`` enables KV-cache incremental decoding (beyond
+        the reference, which is a trainer only): the first call (flax
+        init, or the prompt prefill at full length) sizes the cache; each
+        subsequent ``apply(..., mutable=["cache"])`` call appends this
+        step's k/v at the running index and attends the new queries over
+        the whole cache with bottom-right causal masking.  ``positions``
+        [T] are the global positions of the current tokens (drives RoPE;
+        defaults to arange)."""
         bsz, tgt_len, embed_dim = query.shape
         assert embed_dim == self.embed_dim
         head_dim = self.embed_dim // self.num_heads
@@ -278,7 +288,33 @@ class SelfMultiheadAttention(nn.Module):
         if self.rotary:
             from .rotary import apply_rotary_qk
 
-            q, k = apply_rotary_qk(q, k, base=self.rotary_base)
+            q, k = apply_rotary_qk(q, k, base=self.rotary_base,
+                                   positions=positions)
+
+        if decode:
+            # the cache path supports exactly the generate() contract;
+            # silently ignoring an operand the caller computed is worse
+            # than refusing it
+            if attn_bias is not None or key_padding_mask is not None:
+                raise NotImplementedError(
+                    "decode=True does not support attn_bias/"
+                    "key_padding_mask (decoding assumes unpadded prompts; "
+                    "generate() enforces this)"
+                )
+            if return_attn:
+                raise NotImplementedError("decode=True with return_attn")
+            if positions is None and self.rotary and not self.is_initializing():
+                raise ValueError(
+                    "decode=True with rotary requires positions= (the "
+                    "global positions of the current tokens) — without "
+                    "them every step would rotate at position 0"
+                )
+            o = self._decode_attend(q, k, v, scaling)
+            o = o.reshape(bsz, tgt_len, embed_dim)
+            return nn.Dense(
+                self.embed_dim, use_bias=self.bias, kernel_init=bert_init,
+                name="out_proj",
+            )(o)
 
         bias = _canon_bias(attn_bias, bsz, self.num_heads)
         out = _attend(
@@ -301,6 +337,57 @@ class SelfMultiheadAttention(nn.Module):
         if return_attn:
             return o, attn_weights, probs
         return o
+
+    def _decode_attend(self, q, k, v, scaling):
+        """KV-cache attention (cache collection: cached_key/cached_value/
+        cache_index, the flax decoding idiom).  The flax-init pass sizes
+        the cache from the prototype input's length and returns plain
+        causal attention; subsequent mutable-"cache" calls append k/v at
+        the running index and attend over the whole cache."""
+        import jax
+
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_key = self.variable("cache", "cached_key", jnp.zeros,
+                                   k.shape, k.dtype)
+        cached_value = self.variable("cache", "cached_value", jnp.zeros,
+                                     v.shape, v.dtype)
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if not is_initialized:
+            from unicore_tpu.utils import causal_iota_mask
+
+            s = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k)
+            s = s + causal_iota_mask(q.shape[1], k.shape[1])[None, None]
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(
+            cached_key.value, k.astype(cached_key.value.dtype),
+            (0, idx, 0, 0),
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cached_value.value, v.astype(cached_value.value.dtype),
+            (0, idx, 0, 0),
+        )
+        cached_key.value = k_all
+        cached_value.value = v_all
+        cache_index.value = idx + q.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k_all)
+        s = s + _decode_mask(idx, q.shape[1], k_all.shape[1])[None, None]
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v_all)
+
+
+def _decode_mask(idx, tgt_len, cache_len):
+    """Additive [tgt_len, cache_len] mask for incremental decoding: query
+    row r (global position idx + r) sees keys <= idx + r; unwritten cache
+    slots (>= idx + tgt_len) are masked by the same comparison."""
+    import jax
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tgt_len, cache_len), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tgt_len, cache_len), 1)
+    return jnp.where(cols > rows + idx, -1e30, 0.0)
 
 
 class CrossMultiheadAttention(nn.Module):

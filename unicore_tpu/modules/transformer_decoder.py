@@ -54,6 +54,8 @@ class TransformerDecoderLayer(nn.Module):
         encoder_padding_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
         causal: bool = False,
+        decode: bool = False,
+        positions: Optional[jnp.ndarray] = None,
     ):
         act = get_activation_fn(self.activation_fn)
 
@@ -71,8 +73,10 @@ class TransformerDecoderLayer(nn.Module):
             dropout=self.attention_dropout,
             rotary=self.rotary,
             name="self_attn",
-        )(x, key_padding_mask=padding_mask, attn_bias=attn_bias,
-          deterministic=deterministic, causal=causal)
+        )(x, key_padding_mask=None if decode else padding_mask,
+          attn_bias=attn_bias,
+          deterministic=deterministic, causal=causal, decode=decode,
+          positions=positions)
         x = drop(x, self.dropout)
         x = residual + x
         if self.post_ln:
@@ -142,7 +146,16 @@ class TransformerDecoder(nn.Module):
         attn_mask: Optional[jnp.ndarray] = None,
         encoder_attn_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
+        decode: bool = False,
+        positions: Optional[jnp.ndarray] = None,
     ):
+        if decode and self.rel_pos:
+            raise NotImplementedError(
+                "incremental decoding needs a position scheme that does "
+                "not materialize a [T, T] bias at a traced offset — build "
+                "the decoder with rel_pos=False (use rotary or absolute "
+                "positions)"
+            )
         bsz, seq_len, _ = emb.shape
         x = LayerNorm(self.embed_dim, name="emb_layer_norm")(emb)
         if not deterministic and self.emb_dropout > 0.0:
@@ -174,9 +187,9 @@ class TransformerDecoder(nn.Module):
         if self.checkpoint_activations:
             # remat each layer (trade FLOPs for activation memory, same
             # scheme as the encoder): args passed positionally below;
-            # deterministic (7) and causal (8) are Python bools driving
-            # trace-time control flow, so they must be static
-            layer_cls = nn.remat(layer_cls, static_argnums=(7, 8))
+            # deterministic (7), causal (8), and decode (9) are Python
+            # bools driving trace-time control flow, so they must be static
+            layer_cls = nn.remat(layer_cls, static_argnums=(7, 8, 9))
         for i in range(self.decoder_layers):
             x = layer_cls(
                 embed_dim=self.embed_dim,
@@ -190,7 +203,8 @@ class TransformerDecoder(nn.Module):
                 rotary=self.rotary,
                 name=f"layers_{i}",
             )(x, encoder_out, attn_mask, padding_mask, encoder_attn_mask,
-              encoder_padding_mask, deterministic, self.auto_regressive)
+              encoder_padding_mask, deterministic, self.auto_regressive,
+              decode, positions)
 
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)
