@@ -264,6 +264,35 @@ def _worker(pid, port):
         trainer_f2.train_step([local_batch(8)])
     assert abs(digest(trainer_f2) - digest(trainer_f)) < 1e-9
 
+    # -- tensor parallelism with dp spanning the two processes ----------
+    # mesh reshape puts tp innermost: tp=2 pairs each process's two local
+    # devices while the data axis crosses processes — the realistic
+    # multi-host layout (tp over ICI within a host, dp across hosts)
+    args_t = Namespace(**{**vars(args), "tensor_parallel_size": 2})
+    dist_utils.reset_mesh()
+    task_t = ToyTask(args_t)
+
+    class AttnModel(BaseUnicoreModel):
+        @nn.compact
+        def __call__(self, src_tokens, deterministic=True, **kw):
+            from unicore_tpu.modules import SelfMultiheadAttention
+
+            x = nn.Embed(VOCAB, DIM, name="embed")(src_tokens)
+            x = x + SelfMultiheadAttention(
+                embed_dim=DIM, num_heads=4, dropout=0.0, name="attn"
+            )(x, deterministic=deterministic)
+            return nn.Dense(VOCAB, name="out")(x)
+
+    trainer_t = Trainer(args_t, task_t, AttnModel(), ToyLoss(task_t))
+    metrics.reset()
+    with metrics.aggregate("train"):
+        logs = trainer_t.train_step([local_batch(10), local_batch(11)])
+    assert float(logs[0]["sample_size"]) == 2 * 8 * 8
+    k = trainer_t.state["params"]["attn"]["in_proj"]["kernel"]
+    assert not k.sharding.is_fully_replicated, "tp did not shard weights"
+    digests = dist_utils.all_gather_objects(digest(trainer_t))
+    assert np.allclose(digests[0], digests[1]), digests
+
     print("WORKER_OK", pid)
 
 
