@@ -264,6 +264,36 @@ def _worker(pid, port):
         trainer_f2.train_step([local_batch(8)])
     assert abs(digest(trainer_f2) - digest(trainer_f)) < 1e-9
 
+    # mid-run reload of a SHARDED checkpoint: state is already built, so
+    # the restore must rebuild through the deferred init path (a plain
+    # device_get would touch non-addressable shards and raise)
+    trainer_f2.load_checkpoint(path_f)
+    assert abs(digest(trainer_f2) - d_before) < 1e-9
+
+    # -- fsdp=2 x data=2: one process owns ZERO shard pieces ------------
+    # every fsdp piece is replicated across the cross-process data axis,
+    # so the lowest-process-index owner rule hands all of them to process
+    # 0.  The save must still complete: the shard-token collective runs
+    # on EVERY process at the same program point, not just on owners
+    # (otherwise the owners block forever in the allgather).
+    args_h = Namespace(**{**vars(args), "fsdp_size": 2})
+    dist_utils.reset_mesh()
+    task_h = ToyTask(args_h)
+    trainer_h = Trainer(args_h, task_h, ToyModel(), ToyLoss(task_h))
+    metrics.reset()
+    with metrics.aggregate("train"):
+        trainer_h.train_step([local_batch(9)])
+    d_h = digest(trainer_h)
+    path_h = os.path.join(ckpt_dir, "checkpoint_fsdp2.pt")
+    trainer_h.save_checkpoint(path_h, {"epoch": 1})
+    dist_utils.all_gather_objects(("saved_fsdp2", pid))
+    assert not os.path.exists(path_h + ".shard1"), \
+        "process 1 owns no pieces and must not write a shard file"
+    trainer_h2 = Trainer(args_h, task_h, ToyModel(), ToyLoss(task_h))
+    trainer_h2.load_checkpoint(path_h)
+    trainer_h2.init_state(local_batch(9))
+    assert abs(digest(trainer_h2) - d_h) < 1e-9
+
     # -- tensor parallelism with dp spanning the two processes ----------
     # mesh reshape puts tp innermost: tp=2 pairs each process's two local
     # devices while the data axis crosses processes — the realistic

@@ -124,7 +124,7 @@ def load_shard_entries(path, process_index=None, token=None):
             return {}
     else:
         files = sorted(glob.glob(path + ".shard*"))
-    merged = {}
+    accepted = []
     for fn in files:
         with open(fn, "rb") as f:
             payload = pickle.load(f)
@@ -134,6 +134,31 @@ def load_shard_entries(path, process_index=None, token=None):
                 fn, payload.get("token"), token,
             )
             continue
+        accepted.append((fn, payload))
+    if token is None and accepted:
+        # legacy main file with no token: the staleness filter above is
+        # inert, which is exactly when stale siblings from a different
+        # save (crashed mid-write, or a different process count) could
+        # merge silently.  Refuse a token mix outright; even a single
+        # accepted file warrants a loud warning, since nothing proves it
+        # belongs to THIS main file.
+        tokens = {p.get("token") for _, p in accepted}
+        if len(tokens) > 1:
+            raise ValueError(
+                f"shard files next to {path} carry mixed save tokens "
+                f"{sorted(map(repr, tokens))} but the main file names "
+                f"none — cannot tell current shards from stale ones; "
+                f"delete the stale .shard* files"
+            )
+        logger.warning(
+            "main checkpoint %s carries no shard token; accepting %d "
+            "shard file(s) with token %r UNVERIFIED — a stale .shard* "
+            "sibling from another save would merge silently; verify the "
+            "files belong together",
+            path, len(accepted), next(iter(tokens)),
+        )
+    merged = {}
+    for _, payload in accepted:
         for key, entries in payload["entries"].items():
             merged.setdefault(key, []).extend(entries)
     return merged

@@ -68,7 +68,8 @@ def pallas_interpret():
 _TIMED_CACHE = {}
 
 
-def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97):
+def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97,
+                        multihost_default=None):
     """MEASURED dispatch: once per distinct config, compile and time both
     implementations of an op and cache whether the Pallas kernel actually
     wins (t_pallas < margin * t_reference — the margin keeps noise from
@@ -79,7 +80,16 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97):
     that the driver's own bench contradicts is worse still.  ``make_*``
     return zero-arg callables that run one compiled step of the op and
     block.  Fail-open: any error during the probe keeps the reference
-    path."""
+    path.
+
+    Multi-host runs NEVER time: per-process wall clocks can disagree on a
+    near-margin shape, tracing different programs into one SPMD step
+    (silent numerics drift, or a hang when collective layouts diverge) —
+    and fixing that with a verdict broadcast would plant a collective
+    behind per-process fail-open guards, trading drift for a deadlock.
+    Instead each call site supplies ``multihost_default``, a deterministic
+    static verdict identical on every process (defaults to the reference
+    path)."""
     hit = _TIMED_CACHE.get(key)
     if hit is not None:
         return hit
@@ -88,6 +98,15 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97):
 
     import jax
 
+    if jax.process_count() > 1:
+        win = bool(multihost_default)
+        logging.getLogger(__name__).info(
+            "multi-host run: static kernel verdict for %r -> %s "
+            "(timed dispatch is single-host only)",
+            key, "pallas" if win else "reference",
+        )
+        _TIMED_CACHE[key] = win
+        return win
     try:
         fp, fr = make_pallas(), make_reference()
 

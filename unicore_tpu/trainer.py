@@ -202,7 +202,17 @@ class Trainer:
         self._all_shard_entries_cache = None
         self._peer_entries_cache: Dict[int, Any] = {}
         self._last_shard_entries: Dict[str, Any] = {}
-        self._run_nonce: Optional[str] = None
+        # run nonce for checkpoint shard tokens: agreed ONCE here, where
+        # every process provably reaches the collective in lockstep (the
+        # constructor has no recoverable-failure callers), so later save
+        # paths never need to communicate
+        import uuid
+
+        self._run_nonce = uuid.uuid4().hex
+        if jax.process_count() > 1:
+            from unicore_tpu.distributed import all_gather_objects
+
+            self._run_nonce = all_gather_objects(self._run_nonce)[0]
         self.optimizer = None
         self.lr_scheduler = None
         self._num_updates = 0
@@ -360,14 +370,34 @@ class Trainer:
                     cache.setdefault("params/" + k[len("ema/"):], cache[k])
             self._all_shard_entries_cache = cache
         full = np.empty(shape, dtype=dtype)
-        covered = 0
+        # exact boolean coverage mask: an element-count sum double-counts
+        # overlapping pieces (duplicate/aliased entries) and can pass with
+        # real gaps, leaving np.empty garbage in the restored parameter
+        covered = np.zeros(shape, dtype=bool)
         for nidx, piece in self._all_shard_entries_cache.get(key, []):
-            full[tuple(slice(a, b) for a, b in nidx)] = piece
-            covered += np.asarray(piece).size
-        if covered < int(np.prod(shape, dtype=np.int64)):
+            sl = tuple(slice(a, b) for a, b in nidx)
+            piece = np.asarray(piece)
+            overlap = covered[sl]
+            # equal_nan: identical duplicate pieces must not read as a
+            # conflict just because a diverged run checkpointed NaNs
+            same = np.array_equal(
+                full[sl][overlap], piece[overlap],
+                equal_nan=np.issubdtype(piece.dtype, np.inexact),
+            )
+            if overlap.any() and not same:
+                raise ValueError(
+                    f"conflicting shard pieces for {key} at {nidx}: "
+                    f"overlapping entries disagree — mixed shard files "
+                    f"from different saves next to "
+                    f"{self._pending_loaded_path}?"
+                )
+            full[sl] = piece
+            covered[sl] = True
+        if not covered.all():
+            missing = int(covered.size - covered.sum())
             raise ValueError(
                 f"checkpoint shard files do not cover {key} "
-                f"(have {covered} of {int(np.prod(shape))} elements); "
+                f"({missing} of {covered.size} elements missing); "
                 f"missing .shard files next to {self._pending_loaded_path}?"
             )
         return jax.device_put(jnp.asarray(full), sharding)
@@ -1209,15 +1239,10 @@ class Trainer:
     def _shard_token(self):
         """One token per save, identical on every process: binds the
         ``.shard*`` files to their main file so restore can reject stale
-        siblings from an earlier save with a different process count."""
-        if self._run_nonce is None:
-            import uuid
-
-            from unicore_tpu.distributed import all_gather_objects
-
-            # broadcast process 0's nonce (every process calls collect at
-            # the same program point, so the collective is in lockstep)
-            self._run_nonce = all_gather_objects(uuid.uuid4().hex)[0]
+        siblings from an earlier save with a different process count.
+        Communication-free — the run nonce was agreed at construction —
+        so it is safe inside save paths whose callers treat per-process
+        failure as recoverable (a collective here could strand peers)."""
         return f"{self._run_nonce}:{self.get_num_updates()}"
 
     @staticmethod
@@ -1317,8 +1342,13 @@ class Trainer:
         its worker thread.  Returns (state_dict, shard_entries)."""
         state_dict = self.state_dict()
         state_dict["extra_state"].update(extra_state)
-        if self._last_shard_entries:
-            state_dict["shard_token"] = self._shard_token()
+        # The token is attached unconditionally (not just when this
+        # process owns shard entries): it is communication-free and cheap,
+        # and a main file that always names its token lets restore reject
+        # stale .shard* siblings even when THIS save produced none —
+        # e.g. pure-DP meshes hand every replicated piece to process 0,
+        # yet peers' older shard files may still sit in the directory.
+        state_dict["shard_token"] = self._shard_token()
         return state_dict, self._last_shard_entries
 
     def save_checkpoint(self, filename, extra_state):
@@ -1438,6 +1468,20 @@ class Trainer:
         # mismatch fails with the offending path named
         self._pending_loaded_state = state
         if self.state is not None:
-            # state already built (e.g. mid-run reload): merge immediately
-            fresh = jax.device_get(self.state)
-            self._install_state(self._merge_loaded_state(fresh))
+            # mid-run reload: device_get on fsdp/tp-sharded live state
+            # would touch non-addressable shards and raise, so rebuild
+            # through the same deferred path a fresh start uses — re-init
+            # from the dummy batch, then merge the stashed checkpoint tree
+            # over it inside init_state.  The live state is restored on
+            # failure: a caller that survives a bad reload must keep
+            # training on the weights it had, not silently restart from a
+            # fresh random init at the next step.
+            prev = self.state
+            self.state = None
+            try:
+                self.init_state(self._dummy_batch)
+            except Exception:
+                self.state = prev
+                self._pending_loaded_state = None
+                self._pending_loaded_entries = None
+                raise
