@@ -1,0 +1,11 @@
+"""Uni-Mol-style molecular pretraining plugin (``--user-dir examples/mol``).
+
+The BASELINE configs[1] workload: atom tokens + 3-D conformers, a
+Gaussian-basis pair bias steering every attention layer, and the
+three-term masked-atom / coordinate-denoising / pair-distance objective.
+Fourth model family next to ``examples/bert`` (encoder MLM),
+``examples/lm`` (causal decoder), and ``examples/evoformer`` (pair
+stack + IPA).
+"""
+
+from . import loss, model, task  # noqa: F401 — trigger @register_* decorators

@@ -1,0 +1,241 @@
+"""Uni-Mol-style 3-D molecular transformer.
+
+The shape of the model Uni-Core exists to train (BASELINE configs[1]):
+atom embeddings run through the shared :class:`TransformerEncoder` while
+every layer's attention is steered by a pairwise bias computed from
+interatomic distances — a learned Gaussian basis expansion with
+per-edge-type affine calibration, projected to one bias per head (the
+reference feeds exactly such a bias through ``softmax_dropout``,
+``/root/reference/unicore/modules/softmax_dropout.py:53-99``).
+
+TPU-first choices vs the torch original: distances and edge types are
+derived INSIDE the jitted model from ``[B,N,3]`` coordinates and
+``[B,N]`` tokens (the [B,N,N] tensors never cross host->device), and the
+output pair representation is rebuilt from the final states with one
+einsum rather than threading attention probabilities out of every layer
+(which would force the materialized O(N^2) attention path and kill the
+fused kernels).
+
+Heads: tied-embedding masked-atom logits, a distance-delta head, and an
+equivariant coordinate head (pairwise displacement vectors weighted by a
+learned pair scalar — rotation-equivariant by construction).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu.models import (
+    BaseUnicoreModel,
+    register_model,
+    register_model_architecture,
+)
+from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
+from unicore_tpu.utils import get_activation_fn
+
+
+class GaussianBasis(nn.Module):
+    """Distance -> smooth radial features, calibrated per atom-pair type.
+
+    ``phi_k(d; t) = exp(-0.5 ((mul_t * d + bias_t - mean_k) / std_k)^2)``
+    with learned kernel centers/widths and a per-edge-type affine; K
+    kernels spread over [0, span] Angstroms at init.
+    """
+
+    n_kernels: int = 32
+    n_edge_types: int = 1
+    span: float = 12.0
+
+    @nn.compact
+    def __call__(self, dist, edge_type):
+        k = self.n_kernels
+        means = self.param(
+            "means",
+            lambda _, shape: jnp.linspace(0.0, self.span, shape[0]),
+            (k,),
+        )
+        stds = self.param(
+            "stds",
+            lambda _, shape: jnp.full(shape, self.span / shape[0]),
+            (k,),
+        )
+        mul = nn.Embed(self.n_edge_types, 1, name="mul",
+                       embedding_init=nn.initializers.ones)(edge_type)[..., 0]
+        bias = nn.Embed(self.n_edge_types, 1, name="bias",
+                        embedding_init=nn.initializers.zeros)(edge_type)[..., 0]
+        x = (mul * dist + bias)[..., None]  # [B, N, N, 1]
+        std = jnp.maximum(jnp.abs(stds), 1e-3)
+        return jnp.exp(-0.5 * jnp.square((x - means) / std))
+
+
+class AtomHead(nn.Module):
+    """Masked-atom logits through the tied embedding projection."""
+
+    embed_dim: int
+    vocab_size: int
+    activation_fn: str
+
+    @nn.compact
+    def __call__(self, x, embed_attend):
+        x = nn.Dense(self.embed_dim, kernel_init=bert_init, name="dense")(x)
+        x = get_activation_fn(self.activation_fn)(x)
+        x = LayerNorm(self.embed_dim, name="norm")(x)
+        bias = self.param("bias", nn.initializers.zeros, (self.vocab_size,))
+        return embed_attend(x) + bias
+
+
+@register_model("unimol")
+class UniMolModel(BaseUnicoreModel):
+    vocab_size: int = 16
+    pad_idx: int = 0
+    encoder_layers: int = 6
+    embed_dim: int = 256
+    ffn_embed_dim: int = 1024
+    attention_heads: int = 8
+    pair_hidden_dim: int = 32
+    gaussian_kernels: int = 32
+    max_atoms: int = 32
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_fn: str = "gelu"
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("--encoder-layers", type=int, metavar="L")
+        parser.add_argument("--encoder-embed-dim", type=int, metavar="E")
+        parser.add_argument("--encoder-ffn-embed-dim", type=int, metavar="F")
+        parser.add_argument("--encoder-attention-heads", type=int, metavar="H")
+        parser.add_argument("--pair-hidden-dim", type=int, metavar="P")
+        parser.add_argument("--gaussian-kernels", type=int, metavar="K")
+        parser.add_argument("--dropout", type=float, metavar="D")
+        parser.add_argument("--attention-dropout", type=float, metavar="D")
+        parser.add_argument("--activation-fn", type=str)
+
+    @classmethod
+    def build_model(cls, args, task):
+        return cls(
+            vocab_size=len(task.dictionary),
+            pad_idx=task.dictionary.pad(),
+            encoder_layers=args.encoder_layers,
+            embed_dim=args.encoder_embed_dim,
+            ffn_embed_dim=args.encoder_ffn_embed_dim,
+            attention_heads=args.encoder_attention_heads,
+            pair_hidden_dim=args.pair_hidden_dim,
+            gaussian_kernels=args.gaussian_kernels,
+            max_atoms=args.max_atoms,
+            dropout=getattr(args, "dropout", 0.1) or 0.0,
+            attention_dropout=getattr(args, "attention_dropout", 0.1) or 0.0,
+            activation_fn=getattr(args, "activation_fn", None) or "gelu",
+        )
+
+    @nn.compact
+    def __call__(self, src_tokens, src_coord, deterministic=True, **unused):
+        B, N = src_tokens.shape
+        real = (src_tokens != self.pad_idx)
+        padding_mask = (~real).astype(jnp.float32)
+
+        # pairwise geometry, derived on device (eps keeps the sqrt grad
+        # finite on the diagonal)
+        delta = src_coord[:, :, None, :] - src_coord[:, None, :, :]
+        dist = jnp.sqrt(jnp.sum(jnp.square(delta), axis=-1) + 1e-8)
+        edge_type = src_tokens[:, :, None] * self.vocab_size \
+            + src_tokens[:, None, :]
+
+        phi = GaussianBasis(
+            n_kernels=self.gaussian_kernels,
+            n_edge_types=self.vocab_size * self.vocab_size,
+            name="gbf",
+        )(dist, edge_type)
+        h = nn.Dense(self.gaussian_kernels, kernel_init=bert_init,
+                     name="gbf_proj_in")(phi)
+        h = get_activation_fn(self.activation_fn)(h)
+        attn_bias = nn.Dense(self.attention_heads, kernel_init=bert_init,
+                             name="gbf_proj_out")(h)
+        # zero the bias wherever either endpoint is padding: the attention
+        # key mask re-excludes padded keys, this just keeps garbage
+        # distances from polluting padded-query rows
+        pair_real = (real[:, :, None] & real[:, None, :])
+        attn_bias = jnp.where(pair_real[..., None], attn_bias, 0.0)
+        attn_bias = jnp.transpose(attn_bias, (0, 3, 1, 2))  # [B, H, N, N]
+
+        embed = nn.Embed(self.vocab_size, self.embed_dim,
+                         embedding_init=bert_init, name="embed_tokens")
+        x = TransformerEncoder(
+            encoder_layers=self.encoder_layers,
+            embed_dim=self.embed_dim,
+            ffn_embed_dim=self.ffn_embed_dim,
+            attention_heads=self.attention_heads,
+            emb_dropout=self.dropout,
+            dropout=self.dropout,
+            attention_dropout=self.attention_dropout,
+            max_seq_len=self.max_atoms,
+            activation_fn=self.activation_fn,
+            rel_pos=False,  # geometry, not sequence order, positions atoms
+            name="encoder",
+        )(embed(src_tokens), attn_mask=attn_bias, padding_mask=padding_mask,
+          deterministic=deterministic)
+
+        logits = AtomHead(
+            embed_dim=self.embed_dim,
+            vocab_size=self.vocab_size,
+            activation_fn=self.activation_fn,
+            name="lm_head",
+        )(x, embed.attend)
+
+        # pair representation from the final states: one bilinear einsum
+        # plus the radial features (cheap next to L encoder layers)
+        P, D = self.pair_hidden_dim, self.embed_dim // self.attention_heads
+        qp = nn.Dense(P * D, kernel_init=bert_init, name="pair_q")(x)
+        kp = nn.Dense(P * D, kernel_init=bert_init, name="pair_k")(x)
+        qp = qp.reshape(B, N, P, D)
+        kp = kp.reshape(B, N, P, D)
+        pair = jnp.einsum("biph,bjph->bijp", qp, kp) / jnp.sqrt(float(D))
+        pair = jnp.concatenate([pair, phi], axis=-1)
+        pair = nn.Dense(P, kernel_init=bert_init, name="pair_mlp")(pair)
+        pair = get_activation_fn(self.activation_fn)(pair)
+        pair = 0.5 * (pair + jnp.swapaxes(pair, 1, 2))  # symmetric heads
+
+        # distance head predicts a delta off the (noisy) input distances
+        ddist = nn.Dense(1, kernel_init=bert_init, name="dist_head")(pair)
+        pred_dist = dist + ddist[..., 0]
+
+        # equivariant coordinate head: displacement vectors weighted by a
+        # learned pair scalar (rotating the input rotates the update)
+        w = nn.Dense(1, kernel_init=bert_init, name="coord_head")(pair)[..., 0]
+        w = w * pair_real.astype(w.dtype)
+        n_real = jnp.maximum(
+            jnp.sum(real.astype(w.dtype), axis=-1), 1.0
+        )[:, None, None]
+        update = jnp.sum((w / n_real)[..., None] * delta, axis=2)
+        pred_coord = src_coord + update
+
+        return {"logits": logits, "pred_coord": pred_coord,
+                "pred_dist": pred_dist}
+
+
+@register_model_architecture("unimol", "unimol")
+def unimol_tiny(args):
+    args.encoder_layers = getattr(args, "encoder_layers", None) or 6
+    args.encoder_embed_dim = getattr(args, "encoder_embed_dim", None) or 256
+    args.encoder_ffn_embed_dim = (
+        getattr(args, "encoder_ffn_embed_dim", None) or 1024
+    )
+    args.encoder_attention_heads = (
+        getattr(args, "encoder_attention_heads", None) or 8
+    )
+    args.pair_hidden_dim = getattr(args, "pair_hidden_dim", None) or 32
+    args.gaussian_kernels = getattr(args, "gaussian_kernels", None) or 32
+
+
+@register_model_architecture("unimol", "unimol_base")
+def unimol_base(args):
+    """The published Uni-Mol backbone scale (15 x 512, 64 heads)."""
+    args.encoder_layers = getattr(args, "encoder_layers", None) or 15
+    args.encoder_embed_dim = getattr(args, "encoder_embed_dim", None) or 512
+    args.encoder_ffn_embed_dim = (
+        getattr(args, "encoder_ffn_embed_dim", None) or 2048
+    )
+    args.encoder_attention_heads = (
+        getattr(args, "encoder_attention_heads", None) or 64
+    )
+    args.pair_hidden_dim = getattr(args, "pair_hidden_dim", None) or 64
+    args.gaussian_kernels = getattr(args, "gaussian_kernels", None) or 128
