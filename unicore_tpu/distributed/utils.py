@@ -152,6 +152,13 @@ def data_sharding(mesh, ndim=None):
 # resharding.
 _TP_COLUMN = frozenset({"fc1", "q_proj", "k_proj", "v_proj"})
 _TP_ROW = frozenset({"fc2", "out_proj"})
+# Vocab-parallel embedding tables (Megatron's VocabParallelEmbedding):
+# [V, E] shards its vocab dim.  XLA's SPMD partitioner compiles the
+# lookup to a shard-local masked gather + psum and the tied-projection
+# logits come out vocab-sharded, with softmax reductions psummed — the
+# exact manual pattern Megatron implements, derived from one annotation
+# (verified against compiled HLO: zero all-gathers of the table).
+_TP_VOCAB_EMBED = frozenset({"embed_tokens", "embed"})
 
 
 def tensor_spec(path_names, shape):
@@ -164,6 +171,12 @@ def tensor_spec(path_names, shape):
     if len(path_names) < 2:
         return None
     mod, leaf = path_names[-2], path_names[-1]
+    if leaf == "embedding" and mod in _TP_VOCAB_EMBED and len(shape) == 2:
+        return ["tensor", None]
+    if mod == "lm_head" and leaf == "bias" and len(shape) == 1:
+        # the tied LM head's output bias lives on the vocab dim: align it
+        # with the vocab-sharded logits so the add needs no resharding
+        return ["tensor"]
     if mod == "in_proj":
         if leaf == "kernel" and len(shape) == 4:
             return [None, None, "tensor", None]
@@ -209,18 +222,34 @@ def state_sharding(mesh, tree):
 
     def spec_for(path, x):
         dims = [None] * x.ndim
+        names = _path_names(path)
         if tp_size > 1 and x.ndim:
-            tp = tensor_spec(_path_names(path), x.shape)
+            tp = tensor_spec(names, x.shape)
             if tp is not None:
                 for d, ax in enumerate(tp):
                     if ax is not None and x.shape[d] % tp_size == 0:
                         dims[d] = ax
         if fsdp_size > 1 and x.ndim:
-            for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
-                if (dims[d] is None and x.shape[d] >= fsdp_size
-                        and x.shape[d] % fsdp_size == 0):
-                    dims[d] = "fsdp"
-                    break
+            if (
+                x.ndim == 2
+                and dims[0] == "tensor"
+                and len(names) >= 2
+                and names[-1] == "embedding"
+                and x.shape[0] % (tp_size * fsdp_size) == 0
+            ):
+                # vocab-parallel embedding under tensor x fsdp: stack BOTH
+                # axes on the vocab dim.  Putting fsdp on the feature dim
+                # makes the lookup emit feature-sharded activations that
+                # must reshard to batch-sharded — an SPMD involuntary
+                # full-remat; vocab-stacking keeps the masked-gather+psum
+                # form with the feature dim intact.
+                dims[0] = ("tensor", "fsdp")
+            else:
+                for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
+                    if (dims[d] is None and x.shape[d] >= fsdp_size
+                            and x.shape[d] % fsdp_size == 0):
+                        dims[d] = "fsdp"
+                        break
         return jax.sharding.NamedSharding(mesh, P(*dims))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
