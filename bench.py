@@ -206,18 +206,28 @@ def _clean(msg, limit=300):
     return " ".join(str(msg).split())[:limit]
 
 
+def _force(out):
+    """Force device execution of everything ``out`` depends on.  On the
+    axon relay ``jax.block_until_ready`` acks before compute completes —
+    multi-ms kernels "measure" at ~0.02ms — so the only reliable barrier
+    is fetching a few real bytes of the result across the link."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if hasattr(leaf, "ndim") and leaf.ndim:
+        leaf = leaf.reshape(-1)[:1]
+    np.asarray(jax.device_get(leaf))
+
+
 def _timed(fn, *args, iters=10, min_window_s=0.08):
     """Best-of-three timed windows, with the iteration count auto-scaled
     so each window spans at least ``min_window_s`` — cheap ops (LN fwd+bwd
     is ~20us) otherwise drown in the relay link's per-dispatch jitter and
     the recorded speedups swing ±40% run to run."""
-    import jax
-
-    out = fn(*args)  # warmup (compile)
-    jax.block_until_ready(out)
+    _force(fn(*args))  # warmup (compile)
     t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
+    _force(fn(*args))
     t1 = time.perf_counter() - t0
     iters = max(iters, min(2000, int(min_window_s / max(t1, 1e-6))))
     best = float("inf")
@@ -225,7 +235,7 @@ def _timed(fn, *args, iters=10, min_window_s=0.08):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)
-        jax.block_until_ready(out)
+        _force(out)
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
 

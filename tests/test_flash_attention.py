@@ -156,6 +156,43 @@ def test_flash_multiblock_grad_parity(rng, qkv, monkeypatch):
         )
 
 
+def test_flash_joint_backward_parity(rng, qkv, monkeypatch):
+    """Pin blocks so T=256 spans a 2x1 grid (n_q=2, n_k=1): the regime of
+    the JOINT one-pass backward (dq + dk + dv, full-K dk/dv scratch) that
+    replaced the dq/dkv two-pass for single-k-block shapes like T=2048."""
+    import unicore_tpu.ops.pallas.flash_attention as fa
+
+    monkeypatch.setattr(
+        fa, "_pick_blocks", lambda tq, tk, bias_itemsize=0: (128, 256)
+    )
+    q, k, v = qkv
+    bias = jnp.asarray(rng.randn(1, H, T, T).astype(np.float32))
+    pad = np.zeros((B, T), dtype=np.int32)
+    pad[:, -32:] = 1
+    pad = jnp.asarray(pad)
+
+    out = flash_attention(q, k, v, bias=bias, key_padding_mask=pad,
+                          is_training=False)
+    ref = ref_attn(q, k, v, bias=bias, pad=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **FWD_TOL)
+
+    def lf(q, k, v, bias):
+        return jnp.sum(
+            flash_attention(q, k, v, bias=bias, key_padding_mask=pad,
+                            is_training=False) ** 2
+        )
+
+    def lr(q, k, v, bias):
+        return jnp.sum(ref_attn(q, k, v, bias=bias, pad=pad) ** 2)
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for name, a, b in zip("q k v bias".split(), g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), err_msg=name, **GRAD_TOL
+        )
+
+
 def test_eligibility_rules():
     assert eligible((2, 4, 256, 64), (2, 4, 256, 64), None)
     assert eligible((2, 4, 256, 64), (2, 4, 256, 64), (1, 4, 256, 256))

@@ -108,16 +108,27 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97,
         _TIMED_CACHE[key] = win
         return win
     try:
+        import numpy as np
+
         fp, fr = make_pallas(), make_reference()
+
+        def force(out):
+            # a real-bytes fetch, NOT block_until_ready: the axon relay
+            # acks readiness before compute completes, which turned these
+            # probe windows into phantom ~0.02ms timings
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            if hasattr(leaf, "ndim") and leaf.ndim:
+                leaf = leaf.reshape(-1)[:1]
+            np.asarray(jax.device_get(leaf))
 
         def window(fn, iters):
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = fn()
-            jax.block_until_ready(out)
+            force(out)
             return (time.perf_counter() - t0) / iters
 
-        jax.block_until_ready(fp()), jax.block_until_ready(fr())  # compile
+        force(fp()), force(fr())  # compile
         # size the windows from a pipelined estimate: a single-dispatch
         # estimate is round-trip-dominated on a relayed chip (measured
         # ~25x the steady-state per-call time) and would produce windows

@@ -246,6 +246,86 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _joint_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, *rest, has_bias, has_pad, scale, causal,
+                      dropout_prob, block_q, block_k, n_h, n_q, n_k):
+    """dq + dk + dv in ONE pass for the n_k == 1, n_q > 1 regime (e.g.
+    T=2048 at blocks (512, 2048)): grid (B, H, qi, kj=1).  dq accumulates
+    per q block exactly like the old dq pass; dk/dv accumulate over qi in
+    a full-K (Tk, D) fp32 scratch and are written on the final qi step —
+    with one k block their output block index is constant per (b, h), so
+    the output window is only ever revisited consecutively (Pallas
+    forbids non-consecutive output revisits; that is what limits this
+    kernel to n_k == 1).  Scores/probs are recomputed once instead of
+    twice, cutting the backward's matmuls from 7 to 5 — the VERDICT r4
+    flash regression at T=2048 (0.888x vs materialized) came down to
+    exactly that second recompute sweep."""
+    refs = list(rest)
+    bias_ref = refs.pop(0) if has_bias else None
+    pad_ref = refs.pop(0) if has_pad else None
+    dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr = refs
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = _scores(q, k, scale, bias_ref, pad_ref, causal, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)
+
+    if dropout_prob > 0.0:
+        keep_prob = 1.0 - dropout_prob
+        seed = _mb_seed(seed_ref, b, h, i, j, n_q, n_k)
+        keep = keep_mask(seed, p.shape, keep_prob)
+        p_drop = jnp.where(keep, p * (1.0 / keep_prob), 0.0)
+    else:
+        keep = None
+        p_drop = p
+
+    ks = pl.ds(j * block_k, block_k)
+    dv_scr[ks, :] += jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if keep is not None:
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_prob)), 0.0)
+    ds = p * (dp - delta)
+    dk_scr[ks, :] += jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dq_scr[...] += jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[ks, :].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[ks, :].astype(dv_ref.dtype)
+
+
 def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, *rest, has_bias, has_pad, scale, causal,
                       dropout_prob, block_q, block_k, n_h, n_q, n_k, n_b,
@@ -593,6 +673,51 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
         extra_in.append(_pad_spec(block_k))
         extra_args.append(pad)
 
+    # joint dq+dk+dv pass (one score recompute instead of two) for the
+    # single-k-block regime: with n_k == 1 the dk/dv output block index is
+    # CONSTANT within each (b, h), so the consecutive-revisit rule holds
+    # for all three outputs (dq's block advances with the i runs).  With
+    # n_k > 1 dk/dv blocks would be revisited non-consecutively across i —
+    # illegal in Pallas — so longer sequences keep the two-pass form.
+    if n_k == 1 and n_q > 1 and 2 * tk * d * 4 <= (6 << 20):
+        kv_out_spec = pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+            memory_space=pltpu.VMEM,
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _joint_bwd_kernel, has_bias=bias is not None,
+                has_pad=pad is not None, scale=scale, causal=causal,
+                dropout_prob=dropout_prob, block_q=block_q, block_k=block_k,
+                n_h=heads, n_q=n_q, n_k=n_k,
+            ),
+            grid=grid,
+            in_specs=common_in + extra_in,
+            out_specs=[_q_spec(block_q, d), kv_out_spec, kv_out_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((tk, d), jnp.float32),
+                pltpu.VMEM((tk, d), jnp.float32),
+            ],
+            interpret=pallas_interpret(),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary",
+                                     "arbitrary"),
+            ),
+        )(*(common_args + extra_args))
+        dbias = None
+        if bias is not None:
+            dbias = _dbias_pass(
+                q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
+                causal, scale, block_q, block_k, bsz, heads, n_q, n_k, tq, tk,
+            )
+        return dq, dk, dv, dbias, None, None
+
     # ---- dq pass: grid (b, h, qi, kj), scratch accumulation over kj ----
     dq = pl.pallas_call(
         functools.partial(
@@ -660,60 +785,71 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
     # ---- dbias pass: grid (h, qi, kj, b), scratch accumulation over b ----
     dbias = None
     if bias is not None:
-        def hmap4(sel):
-            # index maps for the (h, i, j, b) grid
-            return {
-                "q": lambda h, i, j, b: (b, h, i, 0),
-                "kv": lambda h, i, j, b: (b, h, j, 0),
-                "lse": lambda h, i, j, b: (b, h, i, 0),
-                "pad": lambda h, i, j, b: (b, 0, j),
-            }[sel]
-
-        q_spec_b = pl.BlockSpec((1, 1, block_q, d), hmap4("q"),
-                                memory_space=pltpu.VMEM)
-        kv_spec_b = pl.BlockSpec((1, 1, block_k, d), hmap4("kv"),
-                                 memory_space=pltpu.VMEM)
-        lse_spec_b = pl.BlockSpec((1, 1, block_q, 1), hmap4("lse"),
-                                  memory_space=pltpu.VMEM)
-        db_in = [_SEED_SPEC,
-                 q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
-                 lse_spec_b, lse_spec_b]
-        db_args = [seed, q, k, v, g, lse, delta]
-        bB, bH, bQ, bK = bias.shape
-        db_in.append(pl.BlockSpec(
-            (1, 1, 1 if bQ == 1 else block_q, block_k),
-            lambda h, i, j, b: (0, 0 if bH == 1 else h, 0 if bQ == 1 else i, j),
-            memory_space=pltpu.VMEM,
-        ))
-        db_args.append(bias)
-        if pad is not None:
-            db_in.append(pl.BlockSpec((1, 1, block_k), hmap4("pad"),
-                                      memory_space=pltpu.VMEM))
-            db_args.append(pad)
-        dbias_full = pl.pallas_call(
-            functools.partial(
-                _dbias_kernel, has_bias=True, has_pad=pad is not None,
-                scale=scale, causal=causal, dropout_prob=dropout_prob,
-                block_q=block_q, block_k=block_k, n_h=heads, n_q=n_q,
-                n_k=n_k, n_b=bsz,
-            ),
-            grid=(heads, n_q, n_k, bsz),
-            in_specs=db_in,
-            out_specs=pl.BlockSpec(
-                (1, block_q, block_k), lambda h, i, j, b: (h, i, j),
-                memory_space=pltpu.VMEM,
-            ),
-            out_shape=jax.ShapeDtypeStruct((heads, tq, tk), jnp.float32),
-            scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
-            interpret=pallas_interpret(),
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "parallel",
-                                     "arbitrary"),
-            ),
-        )(*db_args)
-        dbias = _reduce_dbias(dbias_full, bias)
+        dbias = _dbias_pass(
+            q, k, v, bias, pad, seed, lse, delta, g, dropout_prob, causal,
+            scale, block_q, block_k, bsz, heads, n_q, n_k, tq, tk,
+        )
 
     return dq, dk, dv, dbias, None, None
+
+
+def _dbias_pass(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
+                causal, scale, block_q, block_k, bsz, heads, n_q, n_k,
+                tq, tk):
+    d = q.shape[3]
+
+    def hmap4(sel):
+        # index maps for the (h, i, j, b) grid
+        return {
+            "q": lambda h, i, j, b: (b, h, i, 0),
+            "kv": lambda h, i, j, b: (b, h, j, 0),
+            "lse": lambda h, i, j, b: (b, h, i, 0),
+            "pad": lambda h, i, j, b: (b, 0, j),
+        }[sel]
+
+    q_spec_b = pl.BlockSpec((1, 1, block_q, d), hmap4("q"),
+                            memory_space=pltpu.VMEM)
+    kv_spec_b = pl.BlockSpec((1, 1, block_k, d), hmap4("kv"),
+                             memory_space=pltpu.VMEM)
+    lse_spec_b = pl.BlockSpec((1, 1, block_q, 1), hmap4("lse"),
+                              memory_space=pltpu.VMEM)
+    db_in = [_SEED_SPEC,
+             q_spec_b, kv_spec_b, kv_spec_b, q_spec_b,
+             lse_spec_b, lse_spec_b]
+    db_args = [seed, q, k, v, g, lse, delta]
+    bB, bH, bQ, bK = bias.shape
+    db_in.append(pl.BlockSpec(
+        (1, 1, 1 if bQ == 1 else block_q, block_k),
+        lambda h, i, j, b: (0, 0 if bH == 1 else h, 0 if bQ == 1 else i, j),
+        memory_space=pltpu.VMEM,
+    ))
+    db_args.append(bias)
+    if pad is not None:
+        db_in.append(pl.BlockSpec((1, 1, block_k), hmap4("pad"),
+                                  memory_space=pltpu.VMEM))
+        db_args.append(pad)
+    dbias_full = pl.pallas_call(
+        functools.partial(
+            _dbias_kernel, has_bias=True, has_pad=pad is not None,
+            scale=scale, causal=causal, dropout_prob=dropout_prob,
+            block_q=block_q, block_k=block_k, n_h=heads, n_q=n_q,
+            n_k=n_k, n_b=bsz,
+        ),
+        grid=(heads, n_q, n_k, bsz),
+        in_specs=db_in,
+        out_specs=pl.BlockSpec(
+            (1, block_q, block_k), lambda h, i, j, b: (h, i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((heads, tq, tk), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        interpret=pallas_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+    )(*db_args)
+    return _reduce_dbias(dbias_full, bias)
 
 
 def _reduce_dbias(dbias_full, bias):
