@@ -226,6 +226,102 @@ def test_bert_torch_bridge_forward_parity(tmp_path):
     )
 
 
+def test_lm_converted_checkpoint_finetunes(tmp_path):
+    """The declarative transformer_lm spec converts a reference-style
+    decoder state dict into a tree the examples/lm model restores through
+    the real --finetune-from-model path, and the trainer can step."""
+    torch = pytest.importorskip("torch")
+    import jax
+    from argparse import Namespace
+
+    from unicore_tpu import metrics
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.tools.convert_torch_checkpoint import convert
+    from unicore_tpu.trainer import Trainer
+
+    V, D, H, F_, T = 37, 16, 2, 32, 8
+    g = torch.Generator().manual_seed(2)
+    sd = {
+        "embed_tokens.weight": torch.randn(V, D, generator=g),
+        "embed_positions.weight": torch.randn(T, D, generator=g),
+        "decoder.emb_layer_norm.weight": torch.ones(D),
+        "decoder.emb_layer_norm.bias": torch.zeros(D),
+        "decoder.final_layer_norm.weight": torch.ones(D),
+        "decoder.final_layer_norm.bias": torch.zeros(D),
+        "decoder.relative_attention_bias.weight":
+            torch.randn(32, H, generator=g),
+        "decoder.layers.0.self_attn.in_proj.weight":
+            torch.randn(3 * D, D, generator=g),
+        "decoder.layers.0.self_attn.in_proj.bias":
+            torch.randn(3 * D, generator=g),
+        "decoder.layers.0.self_attn.out_proj.weight":
+            torch.randn(D, D, generator=g),
+        "decoder.layers.0.self_attn.out_proj.bias":
+            torch.randn(D, generator=g),
+        "decoder.layers.0.self_attn_layer_norm.weight": torch.ones(D),
+        "decoder.layers.0.self_attn_layer_norm.bias": torch.zeros(D),
+        "decoder.layers.0.fc1.weight": torch.randn(F_, D, generator=g),
+        "decoder.layers.0.fc1.bias": torch.randn(F_, generator=g),
+        "decoder.layers.0.fc2.weight": torch.randn(D, F_, generator=g),
+        "decoder.layers.0.fc2.bias": torch.randn(D, generator=g),
+        "out_layer_norm.weight": torch.ones(D),
+        "out_layer_norm.bias": torch.zeros(D),
+        "out_bias": torch.zeros(V),
+        "lm_head.weight": None,  # replaced below with the tied table
+    }
+    sd["lm_head.weight"] = sd["embed_tokens.weight"].clone()
+    src, dst = str(tmp_path / "r.pt"), str(tmp_path / "c.pt")
+    torch.save({"model": sd}, src)
+    convert(src, dst, arch="transformer_lm")
+
+    from examples.lm.model import TransformerLMModel
+    from examples.lm.loss import LMCrossEntropyLoss
+
+    d = Dictionary()
+    for i in range(V - 4):
+        d.add_symbol(f"t{i}")
+    assert len(d) == V
+    args = Namespace(
+        seed=1, update_freq=[1], clip_norm=0.0, ema_decay=-1.0,
+        fp16=False, bf16=False, bf16_sr=False,
+        optimizer="adam", lr=[1e-3], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=10, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+
+    class _Task(UnicoreTask):
+        def __init__(self, a):
+            super().__init__(a)
+            self.dictionary = d
+
+    task = _Task(args)
+    model = TransformerLMModel(
+        vocab_size=V, padding_idx=d.pad(), decoder_layers=1,
+        decoder_embed_dim=D, decoder_ffn_embed_dim=F_,
+        decoder_attention_heads=H, max_seq_len=T,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0,
+    )
+    trainer = Trainer(args, task, model, LMCrossEntropyLoss(task))
+    trainer.load_checkpoint(dst, reset_optimizer=True)
+    toks = np.full((4, T), 5, dtype=np.int64)
+    batch = {"net_input": {"src_tokens": toks}, "target": toks.copy()}
+    trainer.init_state(batch)
+    got = np.asarray(
+        jax.device_get(trainer.state["params"]["embed_tokens"]["embedding"])
+    )
+    np.testing.assert_allclose(got, sd["embed_tokens.weight"].numpy(),
+                               rtol=1e-6)
+    metrics.reset()
+    with metrics.aggregate("train"):
+        logs = trainer.train_step([batch])
+    assert np.isfinite(float(logs[0]["loss"]))
+
+
 def test_bert_converted_checkpoint_finetunes(tmp_path):
     """The converted checkpoint loads through the real restore path
     (--finetune-from-model semantics: params only, fresh optimizer)."""

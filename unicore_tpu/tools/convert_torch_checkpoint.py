@@ -3,19 +3,27 @@
 Usage::
 
     python -m unicore_tpu.tools.convert_torch_checkpoint in.pt out.pt \
-        [--arch bert] [--param-map map.json]
+        [--arch bert|transformer_lm] [--param-map map.json]
 
 Reads the torch checkpoint (zipfile or legacy pickle; reference layout
 ``{"model": state_dict, "args": ..., "extra_state": ...}``,
 ``unicore/trainer.py:299-325``) on CPU and converts every tensor to numpy.
 
-With ``--arch bert`` the flat torch state dict is restructured into this
-framework's nested flax tree (reference ``examples/bert/model.py:18-260``
-names -> the ``examples/bert`` flax module tree, transposing Linear
-weights and folding the fused QKV into the [D, 3, H, Dh] DenseGeneral
-kernel), and the output is a DIRECTLY LOADABLE checkpoint::
+With ``--arch`` the flat torch state dict is restructured into this
+framework's nested flax tree and the output is DIRECTLY LOADABLE::
 
     unicore-train DATA ... --finetune-from-model out.pt
+
+Each architecture bridge is a DECLARATIVE SPEC — an ordered list of
+``(source-name regex, target path template, transform)`` rules — so new
+encoder-family models need a rule table, not a bespoke converter:
+
+- the regex fully matches a torch parameter name; its groups fill the
+  ``{0}``/``{1}`` slots of the ``/``-separated target path;
+- ``transform`` names how the tensor's layout changes crossing the
+  torch->flax boundary: ``linear_kernel`` (nn.Linear stores [out, in],
+  Dense kernels are [in, out]), ``qkv_kernel``/``qkv_bias`` (the fused
+  in_proj folds into the [D, 3, H, Dh] DenseGeneral layout), or None.
 
 Without ``--arch``, the flat numpy dict is stored under ``"torch_model"``
 for a model-specific loader, optionally pre-renamed via ``--param-map``
@@ -32,125 +40,216 @@ import sys
 logger = logging.getLogger(__name__)
 
 
-def _t(w):
+# ----------------------------------------------------------------------
+# transforms: how a tensor's layout changes crossing torch -> flax
+# ----------------------------------------------------------------------
+
+def _t(w, ctx=None):
     """torch Linear stores [out, in]; flax Dense kernels are [in, out]."""
     return w.T.copy()
 
 
-def bert_flax_params(flat, heads=None):
-    """Reference examples/bert BertModel state_dict -> flax params tree.
+def _qkv_kernel(w, ctx):
+    """Fused in_proj weight [3D, D] (row-blocks q|k|v) -> DenseGeneral
+    kernel [D, 3, H, Dh]."""
+    heads = ctx["heads"]
+    wt = _t(w)
+    d = wt.shape[0]
+    return wt.reshape(d, 3, heads, d // heads)
 
-    ``flat``: {torch param name: np.ndarray}.  ``heads`` is inferred from
-    ``sentence_encoder.relative_attention_bias.weight`` ([buckets, H])
-    when not given.  Returns (params_tree, unused_keys)."""
+
+def _qkv_bias(b, ctx):
+    heads = ctx["heads"]
+    return b.reshape(3, heads, b.shape[0] // (3 * heads))
+
+
+TRANSFORMS = {
+    None: lambda v, ctx: v,
+    "linear_kernel": _t,
+    "qkv_kernel": _qkv_kernel,
+    "qkv_bias": _qkv_bias,
+}
+
+
+# ----------------------------------------------------------------------
+# the spec engine
+# ----------------------------------------------------------------------
+
+def _set_path(tree, path, value):
+    node = tree
+    for part in path[:-1]:
+        node = node.setdefault(part, {})
+    node[path[-1]] = value
+
+
+def apply_spec(flat, rules, ctx):
+    """Map a flat torch state dict through an ordered rule table.
+
+    Returns ``(params_tree, unused_names)``.  First matching rule wins;
+    a rule whose transform is the string ``"drop"`` consumes the tensor
+    without emitting anything (e.g. buffers the flax tree derives)."""
     import numpy as np
 
-    if heads is None:
-        rb = flat.get("sentence_encoder.relative_attention_bias.weight")
-        if rb is None:
-            raise ValueError(
-                "cannot infer --heads: checkpoint has no "
-                "relative_attention_bias (pass --heads explicitly)"
-            )
-        heads = int(rb.shape[1])
-
-    used = set()
-
-    def take(name):
-        used.add(name)
-        return np.asarray(flat[name])
-
-    def layer_norm(prefix):
-        return {"weight": take(prefix + ".weight"),
-                "bias": take(prefix + ".bias")}
-
-    def dense(prefix):
-        return {"kernel": _t(take(prefix + ".weight")),
-                "bias": take(prefix + ".bias")}
-
-    params = {
-        "embed_tokens": {"embedding": take("embed_tokens.weight")},
-        "embed_positions": take("embed_positions.weight"),
-    }
-
-    enc = {
-        "emb_layer_norm": layer_norm("sentence_encoder.emb_layer_norm"),
-    }
-    if "sentence_encoder.final_layer_norm.weight" in flat:
-        enc["final_layer_norm"] = layer_norm(
-            "sentence_encoder.final_layer_norm"
-        )
-    if "sentence_encoder.relative_attention_bias.weight" in flat:
-        enc["relative_attention_bias"] = {
-            "weight": take("sentence_encoder.relative_attention_bias.weight")
-        }
-
-    layer_ids = [
-        int(m.group(1))
-        for m in (re.match(r"sentence_encoder\.layers\.(\d+)\.", k)
-                  for k in flat)
-        if m
-    ]
-    if not layer_ids:
-        raise ValueError(
-            "checkpoint has no sentence_encoder.layers.* tensors — not a "
-            "reference examples/bert BertModel state dict (wrong --arch?)"
-        )
-    n_layers = 1 + max(layer_ids)
-    for i in range(n_layers):
-        p = f"sentence_encoder.layers.{i}"
-        # fused QKV: torch [3D, D] row-blocks q|k|v -> transpose to
-        # [D, 3D] (q = first D columns, matching chunk(3, dim=-1)) ->
-        # DenseGeneral kernel [D, 3, H, Dh]
-        w = _t(take(f"{p}.self_attn.in_proj.weight"))
-        d = w.shape[0]
-        head_dim = d // heads
-        enc[f"layers_{i}"] = {
-            "self_attn": {
-                "in_proj": {
-                    "kernel": w.reshape(d, 3, heads, head_dim),
-                    "bias": take(f"{p}.self_attn.in_proj.bias").reshape(
-                        3, heads, head_dim
-                    ),
-                },
-                "out_proj": dense(f"{p}.self_attn.out_proj"),
-            },
-            "self_attn_layer_norm": layer_norm(f"{p}.self_attn_layer_norm"),
-            "fc1": dense(f"{p}.fc1"),
-            "fc2": dense(f"{p}.fc2"),
-            "final_layer_norm": layer_norm(f"{p}.final_layer_norm"),
-        }
-    params["sentence_encoder"] = enc
-
-    if "lm_head.dense.weight" in flat:
-        params["lm_head"] = {
-            "dense": dense("lm_head.dense"),
-            "layer_norm": layer_norm("lm_head.layer_norm"),
-            "bias": take("lm_head.bias"),
-        }
-        if "lm_head.weight" in flat:
-            used.add("lm_head.weight")
-            if not np.allclose(flat["lm_head.weight"],
-                               flat["embed_tokens.weight"]):
-                logger.warning(
-                    "lm_head.weight is NOT tied to embed_tokens.weight in "
-                    "the source checkpoint; this framework's BertLMHead is "
-                    "always tied — the untied projection is dropped"
-                )
-
-    for k in flat:
-        m = re.match(r"classification_heads\.([^.]+)\.(dense|out_proj)\.", k)
-        if m:
-            name, sub = m.group(1), m.group(2)
-            head = params.setdefault(f"classification_heads_{name}", {})
-            if sub not in head:
-                head[sub] = dense(f"classification_heads.{name}.{sub}")
-
-    unused = sorted(set(flat) - used)
+    params = {}
+    unused = []
+    for name in flat:
+        for pattern, target, transform in rules:
+            m = re.fullmatch(pattern, name)
+            if m is None:
+                continue
+            if transform == "drop":
+                break
+            value = TRANSFORMS[transform](np.asarray(flat[name]), ctx)
+            _set_path(params, target.format(*m.groups()).split("/"), value)
+            break
+        else:
+            unused.append(name)
     return params, unused
 
 
-ARCH_CONVERTERS = {"bert": bert_flax_params}
+def _layer_rules(prefix, target):
+    """The shared transformer-layer rule block (self-attention + FFN +
+    layer norms) under ``<prefix>.layers.N.`` -> ``<target>/layers_N/``."""
+    p, t = re.escape(prefix), target
+    return [
+        (rf"{p}\.layers\.(\d+)\.self_attn\.in_proj\.weight",
+         t + "/layers_{0}/self_attn/in_proj/kernel", "qkv_kernel"),
+        (rf"{p}\.layers\.(\d+)\.self_attn\.in_proj\.bias",
+         t + "/layers_{0}/self_attn/in_proj/bias", "qkv_bias"),
+        (rf"{p}\.layers\.(\d+)\.self_attn\.out_proj\.weight",
+         t + "/layers_{0}/self_attn/out_proj/kernel", "linear_kernel"),
+        (rf"{p}\.layers\.(\d+)\.self_attn\.out_proj\.bias",
+         t + "/layers_{0}/self_attn/out_proj/bias", None),
+        (rf"{p}\.layers\.(\d+)\.(fc1|fc2)\.weight",
+         t + "/layers_{0}/{1}/kernel", "linear_kernel"),
+        (rf"{p}\.layers\.(\d+)\.(fc1|fc2)\.bias",
+         t + "/layers_{0}/{1}/bias", None),
+        (rf"{p}\.layers\.(\d+)"
+         r"\.(self_attn_layer_norm|final_layer_norm)\.(weight|bias)",
+         t + "/layers_{0}/{1}/{2}", None),
+    ]
+
+
+def _stack_rules(prefix, target):
+    """Rules for the encoder/decoder stack container itself."""
+    p, t = re.escape(prefix), target
+    return [
+        (rf"{p}\.emb_layer_norm\.(weight|bias)",
+         t + "/emb_layer_norm/{0}", None),
+        (rf"{p}\.final_layer_norm\.(weight|bias)",
+         t + "/final_layer_norm/{0}", None),
+        (rf"{p}\.relative_attention_bias\.weight",
+         t + "/relative_attention_bias/weight", None),
+    ]
+
+
+BERT_RULES = (
+    [
+        (r"embed_tokens\.weight", "embed_tokens/embedding", None),
+        (r"embed_positions\.weight", "embed_positions", None),
+    ]
+    + _stack_rules("sentence_encoder", "sentence_encoder")
+    + _layer_rules("sentence_encoder", "sentence_encoder")
+    + [
+        (r"lm_head\.dense\.weight", "lm_head/dense/kernel", "linear_kernel"),
+        (r"lm_head\.dense\.bias", "lm_head/dense/bias", None),
+        (r"lm_head\.layer_norm\.(weight|bias)", "lm_head/layer_norm/{0}",
+         None),
+        (r"lm_head\.bias", "lm_head/bias", None),
+        # the untied projection is handled by the post hook (tie check)
+        (r"lm_head\.weight", "", "drop"),
+        (r"classification_heads\.([^.]+)\.(dense|out_proj)\.weight",
+         "classification_heads_{0}/{1}/kernel", "linear_kernel"),
+        (r"classification_heads\.([^.]+)\.(dense|out_proj)\.bias",
+         "classification_heads_{0}/{1}/bias", None),
+    ]
+)
+
+# decoder-only LM (examples/lm TransformerLMModel): reference-style
+# decoder naming (transformer_decoder(_layer).py: in_proj fused self-attn,
+# q/k/v/out_proj cross-attn) plus the tied-head out_layer_norm/out_bias
+LM_RULES = (
+    [
+        (r"embed_tokens\.weight", "embed_tokens/embedding", None),
+        (r"embed_positions\.weight", "embed_positions", None),
+    ]
+    + _stack_rules("decoder", "decoder")
+    + _layer_rules("decoder", "decoder")
+    + [
+        (r"decoder\.layers\.(\d+)"
+         r"\.encoder_attn\.(q_proj|k_proj|v_proj|out_proj)\.weight",
+         "decoder/layers_{0}/encoder_attn/{1}/kernel", "linear_kernel"),
+        (r"decoder\.layers\.(\d+)"
+         r"\.encoder_attn\.(q_proj|k_proj|v_proj|out_proj)\.bias",
+         "decoder/layers_{0}/encoder_attn/{1}/bias", None),
+        (r"decoder\.layers\.(\d+)\.encoder_attn_layer_norm\.(weight|bias)",
+         "decoder/layers_{0}/encoder_attn_layer_norm/{1}", None),
+        (r"out_layer_norm\.(weight|bias)", "out_layer_norm/{0}", None),
+        (r"out_bias", "out_bias", None),
+        (r"lm_head\.weight", "", "drop"),  # tied; post hook verifies
+    ]
+)
+
+
+def _infer_heads(flat, table_names):
+    """Heads = width of the rel-pos bias embedding table [buckets, H]."""
+    for name in table_names:
+        if name in flat:
+            return int(flat[name].shape[1])
+    raise ValueError(
+        f"cannot infer --heads: checkpoint has none of {table_names} "
+        f"(pass --heads explicitly)"
+    )
+
+
+def _check_tied_head(flat, head_name):
+    import numpy as np
+
+    if head_name in flat and "embed_tokens.weight" in flat:
+        if not np.allclose(flat[head_name], flat["embed_tokens.weight"]):
+            logger.warning(
+                "%s is NOT tied to embed_tokens.weight in the source "
+                "checkpoint; this framework's output head is always tied — "
+                "the untied projection is dropped", head_name,
+            )
+
+
+ARCH_SPECS = {
+    "bert": {
+        "rules": BERT_RULES,
+        "heads_from": ("sentence_encoder.relative_attention_bias.weight",),
+        "post": lambda flat: _check_tied_head(flat, "lm_head.weight"),
+        "required": r"sentence_encoder\.layers\.0\.",
+    },
+    "transformer_lm": {
+        "rules": LM_RULES,
+        "heads_from": ("decoder.relative_attention_bias.weight",),
+        "post": lambda flat: _check_tied_head(flat, "lm_head.weight"),
+        "required": r"decoder\.layers\.0\.",
+    },
+}
+
+
+def arch_flax_params(arch, flat, heads=None):
+    """Flat torch state dict -> this framework's flax tree for ``arch``.
+
+    Returns (params_tree, unused_keys)."""
+    spec = ARCH_SPECS[arch]
+    if not any(re.match(spec["required"], k) for k in flat):
+        raise ValueError(
+            f"checkpoint has no {spec['required']}* tensors — not a "
+            f"reference {arch} state dict (wrong --arch?)"
+        )
+    if heads is None:
+        heads = _infer_heads(flat, spec["heads_from"])
+    params, unused = apply_spec(flat, spec["rules"], {"heads": heads})
+    spec["post"](flat)
+    return params, unused
+
+
+def bert_flax_params(flat, heads=None):
+    """Back-compat alias for the bert spec."""
+    return arch_flax_params("bert", flat, heads=heads)
 
 
 def convert(in_path, out_path, param_map=None, arch=None, heads=None):
@@ -175,7 +274,7 @@ def convert(in_path, out_path, param_map=None, arch=None, heads=None):
         if isinstance(v, (int, float, str, bool, type(None)))
     }
     if arch is not None:
-        params, unused = ARCH_CONVERTERS[arch](flat, heads=heads)
+        params, unused = arch_flax_params(arch, flat, heads=heads)
         if unused:
             print(f"note: {len(unused)} source tensors unused: "
                   f"{unused[:8]}{'...' if len(unused) > 8 else ''}")
@@ -209,7 +308,7 @@ def main(argv=None):
     p.add_argument("output")
     p.add_argument("--param-map", default=None,
                    help="JSON file mapping torch param names to new names")
-    p.add_argument("--arch", default=None, choices=sorted(ARCH_CONVERTERS),
+    p.add_argument("--arch", default=None, choices=sorted(ARCH_SPECS),
                    help="restructure into this framework's flax tree for "
                         "the named example architecture (directly loadable "
                         "via --finetune-from-model)")
