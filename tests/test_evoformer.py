@@ -208,6 +208,62 @@ def test_evoformer_trainer_step_end_to_end(rng):
 S = 4  # sequences
 
 
+def test_group_flash_matches_materialized(rng):
+    """At flash-eligible dims (T a 128-multiple) the triangle and MSA-row
+    attentions must produce the same output through the grouped flash
+    kernel (forced pallas backend) as through the materialized einsum +
+    softmax path (reference backend) — the O(N^3)-memory blockwise route
+    is a pure backend swap."""
+    from unicore_tpu.modules import MSARowAttentionWithPairBias
+    from unicore_tpu.ops.backend import kernel_backend
+
+    n, c, heads = 128, 32, 4
+    z = jnp.asarray(rng.randn(1, n, n, c).astype(np.float32))
+    mask = np.ones((1, n, n), dtype=np.float32)
+    mask[:, :, -17:] = 0.0
+    mask = jnp.asarray(mask)
+
+    tri = TriangleAttention(embed_dim=c, num_heads=heads, dropout=0.0)
+    params = tri.init(jax.random.PRNGKey(0), z, mask)
+
+    with kernel_backend("pallas"):
+        out_flash = tri.apply(params, z, mask, True)
+    with kernel_backend("reference"):
+        out_ref = tri.apply(params, z, mask, True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), rtol=2e-2, atol=2e-3
+    )
+
+    # gradients flow through the kernel's dbias path into the pair-bias
+    # projection (the bias is an activation here, not a parameter)
+    def loss(p, backend):
+        with kernel_backend(backend):
+            return jnp.sum(tri.apply(p, z, mask, True) ** 2)
+
+    g_flash = jax.grad(lambda p: loss(p, "pallas"))(params)
+    g_ref = jax.grad(lambda p: loss(p, "reference"))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-3
+        ),
+        g_flash, g_ref,
+    )
+
+    s, cm = 4, 16
+    msa = jnp.asarray(rng.randn(1, s, n, cm).astype(np.float32))
+    msa_mask = jnp.asarray(np.ones((1, s, n), dtype=np.float32))
+    row = MSARowAttentionWithPairBias(embed_dim=cm, num_heads=2, dropout=0.0)
+    zsmall = jnp.asarray(rng.randn(1, n, n, 8).astype(np.float32))
+    p2 = row.init(jax.random.PRNGKey(1), msa, zsmall, msa_mask)
+    with kernel_backend("pallas"):
+        o_flash = row.apply(p2, msa, zsmall, msa_mask, True)
+    with kernel_backend("reference"):
+        o_ref = row.apply(p2, msa, zsmall, msa_mask, True)
+    np.testing.assert_allclose(
+        np.asarray(o_flash), np.asarray(o_ref), rtol=2e-2, atol=2e-3
+    )
+
+
 def test_msa_row_attention_oracle(rng):
     """Row attention == explicit jnp composition (softmax over the last
     dim of scores + pair bias + mask), including the [B,1,H,R,R] bias and

@@ -36,11 +36,15 @@ def _mask_to_additive(mask):
     ).astype(jnp.float32)[:, :, None, None, :]
 
 
-def _gated_attention(self, m, bias, add_mask, deterministic):
+def _gated_attention(self, m, bias, mask, deterministic):
     """Shared gated-attention body over a [B, G, Q, C] tensor (flax
     in-place-of-method helper: call from inside an ``@nn.compact``
     ``__call__`` so the q/k/v/gate/out submodules land on the caller).
-    ``bias``/``add_mask`` broadcast against scores [B, G, H, Q, Q]."""
+    ``bias`` broadcasts against scores [B, G, H, Q, Q]; ``mask`` is the
+    RAW [B, G, Q] validity mask.  On TPU eligible shapes route through
+    the grouped flash kernel (no [B, G, H, Q, Q] tensor in HBM)."""
+    from .triangle_attention import group_flash_attention
+
     bsz, g, q_len, _ = m.shape
     head_dim = self.embed_dim // self.num_heads
     assert head_dim * self.num_heads == self.embed_dim
@@ -52,16 +56,21 @@ def _gated_attention(self, m, bias, add_mask, deterministic):
         return y.reshape(bsz, g, q_len, self.num_heads, head_dim)
 
     q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
-    scores = jnp.einsum("bsqhd,bskhd->bshqk", q * scale, k)
 
-    rng = None
-    if not deterministic and self.dropout > 0.0:
-        rng = self.make_rng("dropout")
-    probs = ops.softmax_dropout(
-        scores, self.dropout, rng=rng, is_training=not deterministic,
-        mask=add_mask, bias=bias,
+    o = group_flash_attention(
+        q, k, v, bias, mask, self.dropout, deterministic, self.make_rng,
+        scale,
     )
-    o = jnp.einsum("bshqk,bskhd->bsqhd", probs, v)
+    if o is None:
+        scores = jnp.einsum("bsqhd,bskhd->bshqk", q * scale, k)
+        rng = None
+        if not deterministic and self.dropout > 0.0:
+            rng = self.make_rng("dropout")
+        probs = ops.softmax_dropout(
+            scores, self.dropout, rng=rng, is_training=not deterministic,
+            mask=_mask_to_additive(mask), bias=bias,
+        )
+        o = jnp.einsum("bshqk,bskhd->bsqhd", probs, v)
     o = o.reshape(bsz, g, q_len, self.embed_dim)
     gate = nn.sigmoid(
         nn.Dense(self.embed_dim, kernel_init=nn.initializers.zeros,
@@ -96,9 +105,7 @@ class MSARowAttentionWithPairBias(nn.Module):
         )(zb)
         pair_bias = jnp.transpose(pair_bias, (0, 3, 1, 2))[:, None]
 
-        return _gated_attention(
-            self, m, pair_bias, _mask_to_additive(msa_mask), deterministic
-        )
+        return _gated_attention(self, m, pair_bias, msa_mask, deterministic)
 
 
 class MSAColumnAttention(nn.Module):
@@ -116,9 +123,7 @@ class MSAColumnAttention(nn.Module):
         mt = jnp.swapaxes(msa, 1, 2)  # [B, R, S, C]
         mask = None if msa_mask is None else jnp.swapaxes(msa_mask, 1, 2)
         m = nn.LayerNorm(name="layer_norm")(mt)
-        o = _gated_attention(
-            self, m, None, _mask_to_additive(mask), deterministic
-        )
+        o = _gated_attention(self, m, None, mask, deterministic)
         return jnp.swapaxes(o, 1, 2)
 
 

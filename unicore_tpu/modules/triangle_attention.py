@@ -25,6 +25,55 @@ from unicore_tpu import ops
 bert_init = nn.initializers.normal(stddev=0.02)
 
 
+def group_flash_attention(q, k, v, pair_bias, mask, dropout, deterministic,
+                          make_rng, scale):
+    """Blockwise (flash) path for grouped Evoformer attention.
+
+    The triangle/MSA contracts are plain attention batched over a group
+    dim: q/k/v ``[B, G, T, H, Dh]``, bias ``[B, 1, H, T, T]`` broadcast
+    over G, validity mask ``[B, G, T]``.  Folding ``(B, G)`` into the
+    flash kernel's batch dim makes the group broadcast EXACTLY the
+    kernel's batch-broadcast bias stream, so the ``[B, G, H, T, T]``
+    score/prob tensors never materialize in HBM — the O(N^3) memory the
+    materialized einsum path pays at realistic residue counts.  At
+    T <= 512 the single-block fused backward computes dq/dk/dv/dbias in
+    one sweep.  Returns ``[B, G, T, H, Dh]``, or None when the kernel
+    does not apply (non-128-multiple T, batched bias, probe failure) —
+    callers fall back to the einsum + fused-softmax path."""
+    from unicore_tpu.ops.backend import use_pallas
+    from unicore_tpu.ops.pallas import flash_attention as fa
+
+    if not use_pallas():
+        return None
+    B, G, T, H, D = q.shape
+    bias = None
+    if pair_bias is not None:
+        if pair_bias.shape[0] != 1:
+            return None  # kernel streams one bias for the whole batch
+        bias = pair_bias[0]  # [1, H, T, T]
+    qs = (B * G, H, T, D)
+    if not fa.eligible(qs, qs, None if bias is None else bias.shape):
+        return None
+    dropout_on = (not deterministic) and dropout > 0.0
+    if not fa.probe_ok(q.dtype, T, T, D,
+                       None if bias is None else bias.shape[2],
+                       None if bias is None else bias.dtype,
+                       mask is not None, False, dropout_on):
+        return None
+    rng = make_rng("dropout") if dropout_on else None
+    kpm = None
+    if mask is not None:
+        # flash key-padding semantics: nonzero = PADDED
+        kpm = 1 - mask.reshape(B * G, T).astype(jnp.int32)
+    out = fa.flash_attention(
+        q.reshape(B * G, T, H, D), k.reshape(B * G, T, H, D),
+        v.reshape(B * G, T, H, D), bias=bias, key_padding_mask=kpm,
+        dropout_prob=dropout, rng=rng, is_training=not deterministic,
+        scale=scale,
+    )
+    return out.reshape(B, G, T, H, D)
+
+
 class TriangleAttention(nn.Module):
     """Row- or column-wise gated self-attention over a pair tensor.
 
@@ -65,9 +114,6 @@ class TriangleAttention(nn.Module):
 
         q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
 
-        # scores: [B, G=N, H, Q=M, K=M] — the 5-D triangle contract
-        s = jnp.einsum("bgqhd,bgkhd->bghqk", q * scale, k)
-
         # pair bias from z itself, broadcast over the group dim:
         # [B, M, M, H] -> [B, 1, H, M, M]  (reference bias contract
         # [1orB, 1, h, q, k])
@@ -77,22 +123,27 @@ class TriangleAttention(nn.Module):
         )(z)
         pair_bias = jnp.transpose(pair_bias, (0, 3, 1, 2))[:, None]
 
-        add_mask = None
-        if mask is not None:
-            # [B, G, M] -> additive [B, G, 1, 1, K] (broadcast over H, Q)
-            add_mask = jnp.where(
-                mask.astype(bool), 0.0, -1e9
-            ).astype(jnp.float32)[:, :, None, None, :]
-
-        rng = None
-        if not deterministic and self.dropout > 0.0:
-            rng = self.make_rng("dropout")
-        probs = ops.softmax_dropout(
-            s, self.dropout, rng=rng, is_training=not deterministic,
-            mask=add_mask, bias=pair_bias,
+        o = group_flash_attention(
+            q, k, v, pair_bias, mask, self.dropout, deterministic,
+            self.make_rng, scale,
         )
-
-        o = jnp.einsum("bghqk,bgkhd->bgqhd", probs, v)
+        if o is None:
+            # scores: [B, G=N, H, Q=M, K=M] — the 5-D triangle contract
+            s = jnp.einsum("bgqhd,bgkhd->bghqk", q * scale, k)
+            add_mask = None
+            if mask is not None:
+                # [B, G, M] -> additive [B, G, 1, 1, K] (broadcast H, Q)
+                add_mask = jnp.where(
+                    mask.astype(bool), 0.0, -1e9
+                ).astype(jnp.float32)[:, :, None, None, :]
+            rng = None
+            if not deterministic and self.dropout > 0.0:
+                rng = self.make_rng("dropout")
+            probs = ops.softmax_dropout(
+                s, self.dropout, rng=rng, is_training=not deterministic,
+                mask=add_mask, bias=pair_bias,
+            )
+            o = jnp.einsum("bghqk,bgkhd->bgqhd", probs, v)
         o = o.reshape(bsz, n, m, self.embed_dim)
 
         gate = nn.sigmoid(
