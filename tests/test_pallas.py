@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 from unicore_tpu import ops
-from unicore_tpu.ops.pallas import layer_norm as pl_ln
 from unicore_tpu.ops.pallas import softmax_dropout as pl_sd
 
 
@@ -94,29 +93,3 @@ def test_pallas_softmax_dropout_fwd_bwd_mask_agreement(rng):
     assert np.abs(g_np[dead_rows]).max() == 0.0 if dead_rows.any() else True
 
 
-@pytest.mark.parametrize("dim", [128, 768])
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
-def test_pallas_layer_norm(rng, dim, dtype):
-    x = jnp.asarray(rng.randn(48, dim).astype(np.float32), dtype=dtype)
-    w = jnp.asarray(rng.randn(dim).astype(np.float32))
-    b = jnp.asarray(rng.randn(dim).astype(np.float32))
-    out = pl_ln.layer_norm(x, w, b)
-    ref = ops.layer_norm_reference(x, w, b)
-    tol = 1e-5 if dtype == jnp.float32 else 5e-2
-    np.testing.assert_allclose(
-        np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32), atol=tol
-    )
-
-
-def test_pallas_layer_norm_grads(rng):
-    x = jnp.asarray(rng.randn(32, 128).astype(np.float32))
-    w = jnp.asarray(rng.randn(128).astype(np.float32))
-    b = jnp.asarray(rng.randn(128).astype(np.float32))
-
-    def grads(impl):
-        return jax.grad(
-            lambda xx, ww, bb: jnp.sum(impl(xx, ww, bb) ** 2), argnums=(0, 1, 2)
-        )(x, w, b)
-
-    for a, c in zip(grads(pl_ln.layer_norm), grads(ops.layer_norm_reference)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-3)

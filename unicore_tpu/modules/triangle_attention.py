@@ -40,12 +40,24 @@ def group_flash_attention(q, k, v, pair_bias, mask, dropout, deterministic,
     one sweep.  Returns ``[B, G, T, H, Dh]``, or None when the kernel
     does not apply (non-128-multiple T, batched bias, probe failure) —
     callers fall back to the einsum + fused-softmax path."""
-    from unicore_tpu.ops.backend import use_pallas
+    from unicore_tpu.ops.backend import get_kernel_backend, use_pallas
     from unicore_tpu.ops.pallas import flash_attention as fa
 
     if not use_pallas():
         return None
     B, G, T, H, D = q.shape
+    if get_kernel_backend() != "pallas":
+        # measured on v5e (C_z=128, H=4 -> D=32): the thin head dim
+        # underfeeds the MXU contraction lanes, so the kernel's
+        # sequential (B*G, H) grid loses to XLA's batched einsum until
+        # the materialized [B, G, H, T, T] score tensor itself becomes
+        # the problem — T=256: 0.87x, T=512: 1.11x and the einsum path's
+        # fp32 scores+probs start crowding HBM.  Route blockwise at
+        # T >= 512 or when the score tensor alone would exceed ~4 GB;
+        # a forced pallas backend always takes the kernel.
+        score_gb = B * G * H * T * T * 4 / (1 << 30)
+        if T < 512 and score_gb < 4.0:
+            return None
     bias = None
     if pair_bias is not None:
         if pair_bias.shape[0] != 1:

@@ -1,24 +1,27 @@
-"""Fused LayerNorm.
+"""LayerNorm with fp32 statistics.
 
 Behavioral spec from the reference (``unicore/modules/layer_norm.py:22-83``,
 ``csrc/layernorm/layernorm.cu``): normalize over the last dim with fp32
 statistics (mean/invvar computed in fp32 even for bf16/fp16 inputs), affine
 weight/bias stored fp32 and cast to the input dtype for the multiply.
 
-The reference only fuses for 15 whitelisted dims (``FUSED_LAYER_NORM_SUPPORT_DIM``);
-the TPU analogue is a lane-multiple constraint (last dim % 128 == 0) for the
-Pallas path, with the jnp path covering everything else.
+NO Pallas kernel — a deliberate, measured decision (r5).  The reference
+ships a fused CUDA LayerNorm because eager torch materializes the
+unfused chain; XLA already fuses the whole normalize+affine into one
+loop over the row, and the custom kernel NEVER durably beat it at
+transformer shapes: r3 kernel 0.875x at [32*512, 768] bf16, and the r5
+honest re-measurement (real-bytes sync after every window — the earlier
+1.02x "win" was a phantom of a broken readiness ack on the relayed chip)
+read 0.671x.  The r4 single-pass backward, multi-row grid blocks, and
+bf16-I/O variants were all tried on hardware and none closed a 1.5x gap
+rooted in XLA's fusion simply being the right program for a
+bandwidth-bound row reduction.  The kernel and its timed-dispatch gate
+are deleted; ``layer_norm`` IS the fp32-stats jnp formulation, which XLA
+fuses optimally on TPU.  (See docs/performance.md for the measurement
+history.)
 """
 
-import jax
 import jax.numpy as jnp
-
-from .backend import (
-    get_kernel_backend,
-    kernel_probe_ok,
-    kernel_timed_winner,
-    use_pallas,
-)
 
 
 def layer_norm_reference(x, weight=None, bias=None, eps=1e-5):
@@ -36,80 +39,5 @@ def layer_norm_reference(x, weight=None, bias=None, eps=1e-5):
     return out
 
 
-def layer_norm(x, weight=None, bias=None, eps=1e-5):
-    rows = x.size // x.shape[-1] if x.shape[-1] else 0
-    if (
-        use_pallas()
-        and x.shape[-1] % 128 == 0
-        and rows % 8 == 0  # sublane-tileable row blocks (Mosaic constraint)
-        and weight is not None
-        and bias is not None
-    ):
-        from .pallas import layer_norm as pl_impl
-
-        dim = x.shape[-1]
-        r_blk = pl_impl._pick_r_blk(rows, dim)
-        probe_key = ("layer_norm", x.dtype.name, dim, r_blk,
-                     weight.dtype.name, bias.dtype.name)
-
-        def build():
-            # one grid step with the production BlockSpec (rows = r_blk
-            # re-picks the same block); grad covers the bwd kernel
-            px = jnp.zeros((r_blk, dim), x.dtype)
-            w = jnp.zeros((dim,), weight.dtype)
-            b = jnp.zeros((dim,), bias.dtype)
-
-            def f(px, w, b):
-                return jnp.sum(
-                    pl_impl.layer_norm(px, w, b, eps=eps).astype(jnp.float32)
-                )
-
-            jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(px, w, b).compile()
-
-        if kernel_probe_ok(probe_key, build):
-            # auto mode MEASURES: XLA's own LN fusion beat the r3 kernel
-            # at the flagship shape (BENCH_r03 micro: 0.875x) — route to
-            # the kernel only where it provably wins at this (rows, dim,
-            # dtype); a forced "pallas" backend skips the timing (the
-            # bench's isolated-kernel micros must measure the kernel)
-            if get_kernel_backend() == "pallas" or kernel_timed_winner(
-                ("layer_norm", x.dtype.name, dim, min(rows, 1 << 15),
-                 weight.dtype.name, bias.dtype.name),
-                *_timed_builders(min(rows, 1 << 15), dim, x.dtype,
-                                 weight.dtype, bias.dtype, eps),
-                # multi-host static verdict: XLA's own LN fusion has never
-                # lost to the kernel at transformer shapes (BENCH_r04
-                # micro 1.022x kernel / 0.997x e2e)
-                multihost_default=False,
-            ):
-                return pl_impl.layer_norm(x, weight, bias, eps=eps)
-    return layer_norm_reference(x, weight=weight, bias=bias, eps=eps)
-
-
-def _timed_builders(rows, dim, xdtype, wdtype, bdtype, eps):
-    """(make_pallas, make_reference) for the timed dispatch probe:
-    fwd+bwd at the true shape (rows capped at 32768 to bound probe cost)."""
-    def data():
-        key = jax.random.PRNGKey(0)
-        x = jax.random.normal(key, (rows, dim), jnp.float32).astype(xdtype)
-        return x, jnp.ones((dim,), wdtype), jnp.zeros((dim,), bdtype)
-
-    def make(impl):
-        def build():
-            x, w, b = data()
-
-            def f(x, w, b):
-                return jnp.sum(impl(x, w, b).astype(jnp.float32))
-
-            g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
-            g(x, w, b)  # compile
-            return lambda: g(x, w, b)
-
-        return build
-
-    from .pallas import layer_norm as pl_impl
-
-    return (
-        make(lambda x, w, b: pl_impl.layer_norm(x, w, b, eps=eps)),
-        make(lambda x, w, b: layer_norm_reference(x, w, b, eps=eps)),
-    )
+# one implementation: XLA's fusion is the fast path (see module docstring)
+layer_norm = layer_norm_reference

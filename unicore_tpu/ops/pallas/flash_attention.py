@@ -460,12 +460,20 @@ def _pick_blocks(tq, tk, bias_itemsize=0):
                 return c
         return t
 
-    bq = pick(tq, (512, 256, 128))
+    bq = pick(tq, (512, 384, 256, 128))
     budget_el = (1 << 20) if bias_itemsize == 0 else (
         (1 << 20) * 2 // (2 + bias_itemsize)
     )
     budget = budget_el // bq  # score-block element budget
-    bk = pick(tk, tuple(c for c in (2048, 1024, 512, 256, 128) if c <= budget))
+    # non-power-of-two 128-multiples matter: T=384/640/768/1536 would
+    # otherwise shatter into 128-blocks (a 3x3+ grid and the two-pass
+    # backward).  tk itself leads the candidates: a single k block both
+    # minimizes online-softmax rescales and enables the joint one-pass
+    # backward
+    bk = pick(tk, tuple(
+        c for c in (tk, 2048, 1536, 1024, 768, 512, 384, 256, 128)
+        if c <= budget
+    ))
     return bq, bk
 
 
