@@ -110,34 +110,39 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97,
     try:
         import numpy as np
 
-        fp, fr = make_pallas(), make_reference()
+        # dispatch sites run INSIDE the caller's jit trace (omnistaging
+        # stages even constant-input ops as tracers), so the probes must
+        # escape to an eval context — otherwise the "timing windows" time
+        # TRACING, not the device, and the verdict is noise
+        with _eval_context():
+            fp, fr = make_pallas(), make_reference()
 
-        def force(out):
-            # a real-bytes fetch, NOT block_until_ready: the axon relay
-            # acks readiness before compute completes, which turned these
-            # probe windows into phantom ~0.02ms timings
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            if hasattr(leaf, "ndim") and leaf.ndim:
-                leaf = leaf.reshape(-1)[:1]
-            np.asarray(jax.device_get(leaf))
+            def force(out):
+                # a real-bytes fetch, NOT block_until_ready: the axon
+                # relay acks readiness before compute completes, which
+                # turned these windows into phantom ~0.02ms timings
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                if hasattr(leaf, "ndim") and leaf.ndim:
+                    leaf = leaf.reshape(-1)[:1]
+                np.asarray(jax.device_get(leaf))
 
-        def window(fn, iters):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn()
-            force(out)
-            return (time.perf_counter() - t0) / iters
+            def window(fn, iters):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn()
+                force(out)
+                return (time.perf_counter() - t0) / iters
 
-        force(fp()), force(fr())  # compile
-        # size the windows from a pipelined estimate: a single-dispatch
-        # estimate is round-trip-dominated on a relayed chip (measured
-        # ~25x the steady-state per-call time) and would produce windows
-        # that time the link, not the kernel
-        est = min(window(fp, 20), window(fr, 20))
-        iters = max(50, min(5000, int(0.1 / max(est, 1e-7))))
-        # interleaved P R R P, best-of per side (drift-robust)
-        tp, tr = window(fp, iters), window(fr, iters)
-        tr, tp = min(tr, window(fr, iters)), min(tp, window(fp, iters))
+            force(fp()), force(fr())  # compile
+            # size the windows from a pipelined estimate: a single-dispatch
+            # estimate is round-trip-dominated on a relayed chip (measured
+            # ~25x the steady-state per-call time) and would produce
+            # windows that time the link, not the kernel
+            est = min(window(fp, 20), window(fr, 20))
+            iters = max(50, min(5000, int(0.1 / max(est, 1e-7))))
+            # interleaved P R R P, best-of per side (drift-robust)
+            tp, tr = window(fp, iters), window(fr, iters)
+            tr, tp = min(tr, window(fr, iters)), min(tp, window(fp, iters))
         win = tp < margin * tr
         logging.getLogger(__name__).info(
             "timed kernel probe %r: pallas %.1fus vs reference %.1fus -> %s",
@@ -151,6 +156,15 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97,
         win = False
     _TIMED_CACHE[key] = win
     return win
+
+
+def _eval_context():
+    """Escape any active jax trace so probe work executes on the device."""
+    try:
+        from jax._src.core import eval_context
+    except ImportError:  # pragma: no cover - older/newer jax layout
+        from jax.core import eval_context
+    return eval_context()
 
 
 _PROBE_CACHE = {}
@@ -176,7 +190,10 @@ def kernel_probe_ok(key, builder):
     import logging
 
     try:
-        builder()
+        # escape any active jit trace (see kernel_timed_winner): the
+        # builder's lower().compile() must see concrete arrays
+        with _eval_context():
+            builder()
         ok = True
     except Exception as e:  # noqa: BLE001 — any lowering failure disables
         logging.getLogger(__name__).warning(
