@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from unicore_tpu.ops import dropout as ops_dropout
+
 from .layer_norm import LayerNorm
 from .multihead_attention import _BATCH_AXES, SelfMultiheadAttention, bert_init
 from unicore_tpu.parallel import tp_constraint
@@ -123,7 +125,8 @@ class TransformerEncoderLayer(nn.Module):
         def drop(h, rate):
             if deterministic or rate == 0.0:
                 return h
-            return nn.Dropout(rate=rate, deterministic=False)(h, rng=self.make_rng("dropout"))
+            # uint8-draw dropout (ops/dropout.py): 1.6x the bernoulli path
+            return ops_dropout(h, rate, self.make_rng("dropout"))
 
         residual = x
         if not self.post_ln:
@@ -195,9 +198,7 @@ class TransformerEncoder(nn.Module):
         bsz, seq_len, _ = emb.shape
         x = LayerNorm(self.embed_dim, name="emb_layer_norm")(emb)
         if not deterministic and self.emb_dropout > 0.0:
-            x = nn.Dropout(rate=self.emb_dropout, deterministic=False)(
-                x, rng=self.make_rng("dropout")
-            )
+            x = ops_dropout(x, self.emb_dropout, self.make_rng("dropout"))
 
         if padding_mask is not None:
             x = x * (1 - padding_mask[..., None].astype(x.dtype))
