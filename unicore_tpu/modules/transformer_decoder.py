@@ -175,6 +175,10 @@ class TransformerDecoder(nn.Module):
                 self.max_rel_pos, name="relative_attention_bias",
             )(seq_len)
             attn_mask = rel_pos_bias if attn_mask is None else attn_mask + rel_pos_bias
+        if attn_mask is not None:
+            # compute-dtype bias (see the encoder note): every layer
+            # re-reads this tensor; the scores it adds into are x-dtype
+            attn_mask = attn_mask.astype(x.dtype)
         # causal masking is NOT merged into attn_mask: it flows to the
         # attention core as a flag.  On the flash and sequence-parallel
         # paths it is applied in-kernel, so no [T, T] future-mask tensor

@@ -211,6 +211,12 @@ class TransformerEncoder(nn.Module):
                 self.max_rel_pos, name="relative_attention_bias",
             )(seq_len)
             attn_mask = rel_pos_bias if attn_mask is None else attn_mask + rel_pos_bias
+        if attn_mask is not None:
+            # compute-dtype bias: every layer re-reads this [1, H, T, T]
+            # tensor (12 MB fp32 at BERT dims) fwd and bwd; the scores it
+            # adds into are products of x-dtype operands, so carrying the
+            # bias at fp32 buys no precision the add can use
+            attn_mask = attn_mask.astype(x.dtype)
 
         # NOTE: unlike the reference (transformer_encoder.py:147-155), the
         # key padding mask is NOT merged into the additive attention mask —
