@@ -98,7 +98,7 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
         q.dtype, q.shape[1], k.shape[1], q.shape[3],
         None if bias is None else bias.shape[2],
         None if bias is None else bias.dtype,
-        has_pad, causal, dropout_on,
+        has_pad, causal, dropout_on, heads=q.shape[2],
     )
 
 

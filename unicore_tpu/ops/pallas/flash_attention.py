@@ -562,26 +562,35 @@ def _pick_blocks(tq, tk, bias_itemsize=0):
 
 
 def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
-             dropout_on):
+             dropout_on, heads=1):
     """FAIL-OPEN compile probe for one flash config (round-2 lesson: a
     kernel that doesn't lower must fall back to the einsum path, not kill
     training).  Keyed on everything that affects Mosaic lowering — q/kv
     dtype, seq lens (they fix the block sizes), head dim, bias kind
     (``bias_q`` is None / 1 / tq — the bQ==1 sublane-1 block is its own
     spec) and bias dtype, pad mask presence, causal, dropout.  The probe
-    shrinks batch/heads to 1: grid size does not affect lowering,
-    BlockSpecs are identical."""
+    shrinks the batch to 1 (grid size does not affect lowering) but
+    keeps the REAL head count: in the single-block regime the kernels
+    batch ``_pick_hb(heads, ...)`` heads per grid step with hb-times
+    larger blocks, so a heads=1 probe would compile a different (hb=1)
+    variant than production runs and the fail-open guarantee would be
+    void exactly where VMEM pressure is highest."""
     from unicore_tpu.ops.backend import kernel_probe_ok
 
     dtype = jnp.dtype(dtype)
     bias_dtype = None if bias_q is None else jnp.dtype(bias_dtype)
+    bq_, bk_ = _pick_blocks(
+        tq, tk,
+        0 if (bias_q is None or bias_q == 1) else jnp.dtype(bias_dtype).itemsize,
+    )
+    heads = heads if (tq == bq_ and tk == bk_) else 1  # hb only single-block
     key = ("flash", dtype.name, tq, tk, d, bias_q,
            None if bias_dtype is None else bias_dtype.name,
-           has_pad, causal, dropout_on)
+           has_pad, causal, dropout_on, heads)
 
     def build():
-        q = jnp.zeros((1, tq, 1, d), dtype)
-        kv = jnp.zeros((1, tk, 1, d), dtype)
+        q = jnp.zeros((1, tq, heads, d), dtype)
+        kv = jnp.zeros((1, tk, heads, d), dtype)
         pad = jnp.zeros((1, tk), jnp.int32) if has_pad else None
         rng = jax.random.PRNGKey(0) if dropout_on else None
         dp = 0.1 if dropout_on else 0.0
@@ -594,7 +603,7 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
 
             jax.jit(jax.grad(f, argnums=(0, 1))).lower(q, kv).compile()
         else:
-            bias = jnp.zeros((1, 1, bias_q, tk), bias_dtype)
+            bias = jnp.zeros((1, heads, bias_q, tk), bias_dtype)
 
             def f(q, kv, bias):
                 o = flash_attention(q, kv, kv, bias=bias, **kw)
@@ -752,26 +761,16 @@ def _flash_fwd_hb(q, k, v, bias, pad, dropout_prob, seed, causal, scale,
     bsz, heads, tq, d = q.shape
     tk = k.shape[2]
     hb = _pick_hb(heads, tq, tk, bias is not None)
-
-    def spec4(blk_t):
-        return pl.BlockSpec((1, hb, blk_t, d), lambda g_, b: (b, g_, 0, 0),
-                            memory_space=pltpu.VMEM)
-
+    spec4, lse_spec, bias_spec, pad_spec = _hb_specs(
+        hb, d, block_q, block_k, bias, pad
+    )
     in_specs = [_SEED_SPEC, spec4(block_q), spec4(block_k), spec4(block_k)]
     args = [seed, q, k, v]
     if bias is not None:
-        bB, bH, bQ, bK = bias.shape
-        in_specs.append(pl.BlockSpec(
-            (1, 1 if bH == 1 else hb, bQ, block_k),
-            lambda g_, b: (0, 0 if bH == 1 else g_, 0, 0),
-            memory_space=pltpu.VMEM,
-        ))
+        in_specs.append(bias_spec)
         args.append(bias)
     if pad is not None:
-        in_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda g_, b: (b, 0, 0),
-            memory_space=pltpu.VMEM,
-        ))
+        in_specs.append(pad_spec)
         args.append(pad)
     out, lse = pl.pallas_call(
         functools.partial(
@@ -782,11 +781,7 @@ def _flash_fwd_hb(q, k, v, bias, pad, dropout_prob, seed, causal, scale,
         ),
         grid=(heads // hb, bsz),
         in_specs=in_specs,
-        out_specs=[
-            spec4(block_q),
-            pl.BlockSpec((1, hb, block_q, 1), lambda g_, b: (b, g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        out_specs=[spec4(block_q), lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bsz, heads, tq, 1), jnp.float32),
@@ -1031,6 +1026,34 @@ def _reduce_dbias(dbias_full, bias):
     return db.astype(bias.dtype)
 
 
+def _hb_specs(hb, d, block_q, block_k, bias, pad):
+    """Shared BlockSpecs for the head-batched single-block kernels: grid
+    (H//hb, B); q/k/v/out blocks carry hb heads; a bias with bH == 1
+    broadcasts one head row, otherwise it is blocked per hb heads (THE
+    spec forward and backward must agree on)."""
+    def spec4(blk_t):
+        return pl.BlockSpec((1, hb, blk_t, d), lambda g_, b: (b, g_, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    lse_spec = pl.BlockSpec((1, hb, block_q, 1), lambda g_, b: (b, g_, 0, 0),
+                            memory_space=pltpu.VMEM)
+    bias_spec = None
+    if bias is not None:
+        bB, bH, bQ, bK = bias.shape
+        bias_spec = pl.BlockSpec(
+            (1, 1 if bH == 1 else hb, bQ, block_k),
+            lambda g_, b: (0, 0 if bH == 1 else g_, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+    pad_spec = None
+    if pad is not None:
+        pad_spec = pl.BlockSpec(
+            (1, 1, block_k), lambda g_, b: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+    return spec4, lse_spec, bias_spec, pad_spec
+
+
 def _flash_bwd_fused(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
                      causal, scale, block_q, block_k):
     """dq/dk/dv(/dbias) in ONE kernel over grid (H//hb, B), batch
@@ -1039,29 +1062,17 @@ def _flash_bwd_fused(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
     bsz, heads, tq, tk, d = q.shape[0], q.shape[1], q.shape[2], k.shape[2], q.shape[3]
     want_dbias = bias is not None
     hb = _pick_hb(heads, tq, tk, want_dbias)
-
-    def spec4(blk_t):
-        return pl.BlockSpec((1, hb, blk_t, d), lambda g_, b: (b, g_, 0, 0),
-                            memory_space=pltpu.VMEM)
-
-    lse_spec = pl.BlockSpec((1, hb, block_q, 1), lambda g_, b: (b, g_, 0, 0),
-                            memory_space=pltpu.VMEM)
+    spec4, lse_spec, bias_spec, pad_spec = _hb_specs(
+        hb, d, block_q, block_k, bias, pad
+    )
     in_specs = [_SEED_SPEC, spec4(block_q), spec4(block_k), spec4(block_k),
                 spec4(block_q), lse_spec, lse_spec]
     args = [seed, q, k, v, g, lse, delta]
     if bias is not None:
-        bB, bH, bQ, bK = bias.shape
-        in_specs.append(pl.BlockSpec(
-            (1, 1 if bH == 1 else hb, bQ, block_k),
-            lambda g_, b: (0, 0 if bH == 1 else g_, 0, 0),
-            memory_space=pltpu.VMEM,
-        ))
+        in_specs.append(bias_spec)
         args.append(bias)
     if pad is not None:
-        in_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda g_, b: (b, 0, 0),
-            memory_space=pltpu.VMEM,
-        ))
+        in_specs.append(pad_spec)
         args.append(pad)
 
     out_specs = [spec4(block_q), spec4(block_k), spec4(block_k)]
