@@ -141,3 +141,30 @@ def test_l2_norm_tree(rng):
     np.testing.assert_allclose(
         float(ops.l2_norm(tree)), np.linalg.norm(flat), rtol=1e-6
     )
+
+
+def test_uint8_dropout_statistics():
+    """ops.dropout draws uint8 keep bits: the keep rate must match the
+    QUANTIZED probability q/256 and survivors must be scaled by exactly
+    256/q, so E[dropout(x)] == x holds precisely."""
+    x = jnp.ones((512, 512), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    out = np.asarray(ops.dropout(x, 0.1, key))
+    q = round(0.9 * 256)  # 230
+    kept = (out > 0).mean()
+    assert abs(kept - q / 256.0) < 0.01, kept
+    # exactly two values: 0 and the inverted-dropout scale
+    vals = np.unique(out)
+    np.testing.assert_allclose(
+        vals, [0.0, 256.0 / q], rtol=1e-6
+    )
+    assert abs(out.mean() - 1.0) < 0.02
+    # edge rates: identity below the quantization floor, full drop at ~1
+    np.testing.assert_array_equal(
+        np.asarray(ops.dropout(x, 0.0, key)), np.asarray(x)
+    )
+    assert np.asarray(ops.dropout(x, 0.999, key)).sum() == 0.0
+    # deterministic per rng key (the backward replays the same mask)
+    np.testing.assert_array_equal(out, np.asarray(ops.dropout(x, 0.1, key)))
+    other = np.asarray(ops.dropout(x, 0.1, jax.random.PRNGKey(1)))
+    assert (out != other).any()
