@@ -1,0 +1,577 @@
+"""unicore-lint: every rule must fire on a seeded violation and stay
+silent on clean code (ISSUE 1 acceptance).
+
+Trace rules (UL001-UL006) get tiny fixture programs audited through
+``jax.make_jaxpr`` / ``jit.lower``; source rules (UL101-UL105) get
+fixture files written to tmp_path.  The flagship-config integration
+audit (the CI gate) runs at the end; the multi-variant mesh sweep is
+the only trace-heavy case and stays seconds-fast at audit shapes.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from unicore_tpu.analysis.source_lint import lint_paths
+from unicore_tpu.analysis.trace_audit import (
+    audit_donation,
+    audit_jaxpr,
+    audit_sharding_coverage,
+)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------
+# UL001 upcast-leak
+# ---------------------------------------------------------------------
+
+def test_upcast_leak_fires_on_mixed_dot():
+    def leaky(x, w, bias):
+        h = x + bias           # bf16 + f32 -> promotes h to f32
+        return h @ w           # f32 @ bf16 -> mixed-dtype dot_general
+
+    x = jnp.ones((256, 128), jnp.bfloat16)
+    w = jnp.ones((128, 64), jnp.bfloat16)
+    bias = jnp.ones((256, 128), jnp.float32)
+    found = audit_jaxpr(jax.make_jaxpr(leaky)(x, w, bias))
+    assert "UL001" in rules_of(found)
+
+
+def test_upcast_leak_silent_on_clean_bf16_matmul():
+    def clean(x, w):
+        # bf16 operands with fp32 MXU accumulation: the correct idiom
+        return jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    x = jnp.ones((256, 128), jnp.bfloat16)
+    w = jnp.ones((128, 64), jnp.bfloat16)
+    assert audit_jaxpr(jax.make_jaxpr(clean)(x, w)) == []
+
+
+def test_upcast_leak_pedantic_flags_elementwise_chain():
+    def leaky(x, bias):
+        return x + bias        # convert(x)->f32 feeds f32 add
+
+    x = jnp.ones((256, 128), jnp.bfloat16)
+    bias = jnp.ones((256, 128), jnp.float32)
+    jaxpr = jax.make_jaxpr(leaky)(x, bias)
+    assert "UL001" in rules_of(audit_jaxpr(jaxpr, pedantic=True))
+    # default mode: elementwise-only promotion is not reported (the
+    # repo's deliberate fp32 islands match the same jaxpr pattern)
+    assert audit_jaxpr(jaxpr) == []
+
+
+# ---------------------------------------------------------------------
+# UL002 giant-intermediate
+# ---------------------------------------------------------------------
+
+def test_giant_intermediate_fires_on_materialized_scores():
+    T = 2048
+
+    def attn_scores(q, k):  # [B,H,T,D] x 2 -> [B,H,T,T] fp32 scores
+        return jnp.einsum("bhtd,bhsd->bhts", q, k)
+
+    q = jnp.ones((2, 4, T, 64), jnp.float32)
+    found = audit_jaxpr(jax.make_jaxpr(attn_scores)(q, q), seq_len=T)
+    assert "UL002" in rules_of(found)
+    assert any("O(T^2)" in f.message for f in found)
+
+
+def test_giant_intermediate_fires_on_absolute_budget():
+    def blow_up(x):
+        return jnp.broadcast_to(x, (512, 1024, 1024))  # 2 GiB fp32
+
+    x = jnp.ones((1024, 1024), jnp.float32)
+    found = audit_jaxpr(jax.make_jaxpr(blow_up)(x))
+    assert "UL002" in rules_of(found)
+
+
+def test_giant_intermediate_silent_on_flash_sized_buffers():
+    def small(q, k):
+        return jnp.einsum("bhtd,bhsd->bhts", q, k)  # tiny T
+
+    q = jnp.ones((2, 4, 64, 16), jnp.float32)
+    assert audit_jaxpr(jax.make_jaxpr(small)(q, q), seq_len=64) == []
+
+
+# ---------------------------------------------------------------------
+# UL003 donation-miss
+# ---------------------------------------------------------------------
+
+def _state_step(state, x):
+    return {"p": state["p"] + x.sum()}, (x * 2).sum()
+
+
+def test_donation_miss_fires_without_donate_argnums():
+    state = {"p": jnp.zeros((512, 1024))}  # 2 MiB > the 1 MiB threshold
+    x = jnp.ones((8, 8))
+    lowered = jax.jit(_state_step).lower(state, x)
+    assert rules_of(audit_donation(lowered)) == {"UL003"}
+
+
+def test_donation_silent_with_donate_argnums():
+    state = {"p": jnp.zeros((512, 1024))}
+    x = jnp.ones((8, 8))
+    lowered = jax.jit(_state_step, donate_argnums=(0,)).lower(state, x)
+    assert audit_donation(lowered) == []
+
+
+def test_donation_silent_below_min_bytes():
+    lowered = jax.jit(_state_step).lower(
+        {"p": jnp.zeros((4, 4))}, jnp.ones((4, 4))
+    )
+    assert audit_donation(lowered) == []
+
+
+# ---------------------------------------------------------------------
+# UL004 host-callback
+# ---------------------------------------------------------------------
+
+def test_host_callback_fires_on_debug_print():
+    def noisy(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1
+
+    found = audit_jaxpr(jax.make_jaxpr(noisy)(1.0))
+    assert "UL004" in rules_of(found)
+
+
+def test_host_callback_fires_on_pure_callback():
+    def hostcall(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x,
+        )
+
+    found = audit_jaxpr(jax.make_jaxpr(hostcall)(jnp.ones((4,))))
+    assert "UL004" in rules_of(found)
+
+
+def test_host_callback_silent_on_pure_step():
+    found = audit_jaxpr(jax.make_jaxpr(lambda x: x * 2 + 1)(jnp.ones((4,))))
+    assert found == []
+
+
+# ---------------------------------------------------------------------
+# UL005 sharding-hole (needs the virtual 8-device CPU mesh)
+# ---------------------------------------------------------------------
+
+def _mesh(fsdp=1, tensor=1):
+    devs = np.asarray(jax.devices()[:8]).reshape(
+        8 // (fsdp * tensor), fsdp, 1, tensor
+    )
+    return jax.sharding.Mesh(devs, ("data", "fsdp", "seq", "tensor"))
+
+
+def _named(mesh, *spec):
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*spec)
+    )
+
+
+def test_sharding_hole_fires_on_replicated_leaf_under_fsdp():
+    mesh = _mesh(fsdp=2)
+    shapes = {"params": {"w": jax.ShapeDtypeStruct((256, 64), jnp.float32)}}
+    shardings = {"params": {"w": _named(mesh)}}  # fully replicated
+    found = audit_sharding_coverage(mesh, shardings, shapes)
+    assert rules_of(found) == {"UL005"}
+    assert "fsdp" in found[0].message
+
+
+def test_sharding_hole_fires_on_disengaged_tensor_spec():
+    mesh = _mesh(tensor=2)
+    # embed_tokens/embedding is DESIGNATED tensor-parallel (vocab dim)
+    shapes = {"params": {"embed_tokens": {
+        "embedding": jax.ShapeDtypeStruct((64, 64), jnp.float32)}}}
+    shardings = {"params": {"embed_tokens": {"embedding": _named(mesh)}}}
+    found = audit_sharding_coverage(mesh, shardings, shapes)
+    assert [f.severity for f in found] == ["error"]
+    assert "failed to engage" in found[0].message
+
+
+def test_sharding_hole_warns_on_indivisible_tensor_dim():
+    mesh = _mesh(tensor=2)
+    shapes = {"params": {"embed_tokens": {
+        "embedding": jax.ShapeDtypeStruct((63, 64), jnp.float32)}}}
+    shardings = {"params": {"embed_tokens": {"embedding": _named(mesh)}}}
+    found = audit_sharding_coverage(mesh, shardings, shapes)
+    assert [f.severity for f in found] == ["warning"]
+
+
+def test_sharding_hole_silent_when_sharded_or_undesignated():
+    mesh = _mesh(fsdp=2, tensor=2)
+    shapes = {
+        "params": {
+            "embed_tokens": {
+                "embedding": jax.ShapeDtypeStruct((64, 64), jnp.float32)},
+            "w": jax.ShapeDtypeStruct((256, 64), jnp.float32),
+            "tiny": jax.ShapeDtypeStruct((8,), jnp.float32),
+        }
+    }
+    shardings = {
+        "params": {
+            "embed_tokens": {
+                "embedding": _named(mesh, ("tensor", "fsdp"), None)},
+            "w": _named(mesh, "fsdp", None),
+            "tiny": _named(mesh),  # small leaves legally replicate
+        }
+    }
+    assert audit_sharding_coverage(mesh, shardings, shapes) == []
+
+
+# ---------------------------------------------------------------------
+# UL006 fp64-leak
+# ---------------------------------------------------------------------
+
+def test_fp64_leak_fires_under_x64():
+    from jax.experimental import enable_x64
+
+    with enable_x64(True):
+        jaxpr = jax.make_jaxpr(
+            lambda x: x * np.float64(2.0)
+        )(jnp.ones((4,), jnp.float64))
+    assert "UL006" in rules_of(audit_jaxpr(jaxpr))
+
+
+def test_fp64_leak_silent_on_fp32():
+    jaxpr = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones((4,), jnp.float32))
+    assert audit_jaxpr(jaxpr) == []
+
+
+# ---------------------------------------------------------------------
+# source lint fixtures (UL101-UL105)
+# ---------------------------------------------------------------------
+
+def _lint_snippet(tmp_path, name, code):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([str(f)])
+
+
+def test_jit_missing_donation_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import jax
+        def train_step(state, batch):
+            return state, batch
+        step = jax.jit(train_step)
+    """)
+    assert "UL101" in rules_of(found)
+
+
+def test_jit_missing_donation_fires_on_decorator_forms(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import functools
+        import jax
+        @jax.jit
+        def train_step(state, batch):
+            return state, batch
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def train_step_accum(state, batch, n):
+            return state, batch
+    """)
+    assert sum(1 for f in found if f.rule == "UL101") == 2
+
+
+def test_jit_missing_donation_silent_on_donating_decorator(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import functools
+        import jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state, batch
+        @jax.jit
+        def eval_step(state, batch):  # not a train step: no rule
+            return batch
+    """)
+    assert "UL101" not in rules_of(found)
+
+
+def test_jit_missing_donation_silent_with_donation(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import jax
+        def train_step(state, batch):
+            return state, batch
+        step = jax.jit(train_step, donate_argnums=(0,))
+        evaluate = jax.jit(lambda s, b: s)  # not a train step: no rule
+    """)
+    assert "UL101" not in rules_of(found)
+
+
+def test_numpy_in_jit_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import jax
+        import numpy as np
+        @jax.jit
+        def train_step(state, batch):
+            return state, np.asarray(batch)
+    """)
+    assert "UL102" in rules_of(found)
+
+
+def test_numpy_in_jit_silent_on_metadata_and_unjitted(tmp_path):
+    found = _lint_snippet(tmp_path, "step.py", """
+        import jax
+        import numpy as np
+        @jax.jit
+        def train_step(state, batch):
+            n = np.prod(batch.shape)  # metadata-only: allowed
+            return state, batch / n
+        def host_helper(x):
+            return np.asarray(x)  # not jitted: allowed
+    """)
+    assert "UL102" not in rules_of(found)
+
+
+def test_unseeded_dataset_rng_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "my_dataset.py", """
+        import random
+        import numpy as np
+        def __getitem__(self, index):
+            a = np.random.rand(4)
+            b = random.randint(0, 3)
+            g = np.random.RandomState()
+            return a, b, g
+    """)
+    assert sum(1 for f in found if f.rule == "UL103") == 3
+
+
+def test_unseeded_dataset_rng_silent_inside_numpy_seed(tmp_path):
+    found = _lint_snippet(tmp_path, "my_dataset.py", """
+        import numpy as np
+        from unicore_tpu.data import data_utils
+        def __getitem__(self, index):
+            with data_utils.numpy_seed(self.seed, self.epoch, index):
+                a = np.random.rand(4)
+            gen = np.random.RandomState(42)
+            return a, gen
+    """)
+    assert "UL103" not in rules_of(found)
+
+
+def test_blocking_fetch_fires_and_suppression_works(tmp_path):
+    found = _lint_snippet(tmp_path, "lib.py", """
+        def run(x, y):
+            x.block_until_ready()
+            v = y.item()
+            ok = y.item()  # unicore-lint: disable=UL104
+            return v, ok
+    """)
+    assert sum(1 for f in found if f.rule == "UL104") == 2
+
+
+def test_blocking_fetch_silent_in_stats_slow_path(tmp_path):
+    d = tmp_path / "logging"
+    d.mkdir()
+    f = d / "meters.py"
+    f.write_text("def fmt(v):\n    return v.item()\n")
+    assert lint_paths([str(f)]) == []
+
+
+def test_dropout_dead_rate_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "model.py", """
+        from unicore_tpu.ops.dropout import dropout
+        def f(x, rng):
+            return dropout(x, 0.001, rng)
+    """)
+    assert "UL105" in rules_of(found)
+
+
+def test_dropout_dead_rate_matches_op_at_boundary(tmp_path):
+    # r = 1/512 rounds to q = 256 (identity) in ops/dropout.py — the
+    # lint must agree with the op's quantization, not a re-derived band
+    found = _lint_snippet(tmp_path, "model.py", """
+        from unicore_tpu.ops.dropout import dropout
+        def f(x, rng):
+            return dropout(x, 0.001953125, rng)
+    """)
+    assert "UL105" in rules_of(found)
+
+
+def test_dropout_dead_rate_silent_on_representable_rates(tmp_path):
+    found = _lint_snippet(tmp_path, "model.py", """
+        from unicore_tpu.ops.dropout import dropout
+        def f(x, rng):
+            return dropout(x, 0.1, rng), dropout(x, 0.0, rng)
+    """)
+    assert "UL105" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
+# baseline / suppression mechanics
+# ---------------------------------------------------------------------
+
+def test_baseline_roundtrip_suppresses_known_findings(tmp_path):
+    f1 = Finding("UL104", "blocking-fetch", "error", "a.py:10", "msg one")
+    f2 = Finding("UL104", "blocking-fetch", "error", "b.py:20", "msg two")
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), [f1])
+    fps = load_baseline(str(path))
+    # line numbers must not churn the baseline
+    moved = Finding("UL104", "blocking-fetch", "error", "a.py:99", "msg one")
+    new, suppressed = split_baselined([moved, f2], fps)
+    assert [f.location for f in suppressed] == ["a.py:99"]
+    assert [f.location for f in new] == ["b.py:20"]
+
+
+def test_baseline_missing_file_is_empty():
+    assert load_baseline("/nonexistent/baseline.json") == set()
+
+
+# ---------------------------------------------------------------------
+# integration: the repo itself must be clean, and the flagship config
+# must trace-audit clean over the dryrun meshes (the CI gate)
+# ---------------------------------------------------------------------
+
+def _repo_root():
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_source_lint_clean_within_baseline():
+    import os
+
+    root = _repo_root()
+    roots = [os.path.join(root, d)
+             for d in ("unicore_tpu", "unicore_tpu_cli", "examples")]
+    findings = lint_paths(roots, rel_to=root)
+    fps = load_baseline(os.path.join(root, "tools", "lint_baseline.json"))
+    new, _ = split_baselined(findings, fps)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_flagship_bert_trace_audit_clean():
+    import os
+
+    from unicore_tpu.analysis.scenarios import audit_bert_config
+
+    findings, reports = audit_bert_config(
+        os.path.join(_repo_root(), "examples", "bert"), n_devices=8
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+    ran = [r["variant"] for r in reports if "mesh" in r]
+    assert ran == ["dp", "fsdp2", "tp2", "seq2", "tp2_fsdp2"], reports
+
+
+def test_trainer_trace_audit_catches_seeded_sharding_hole():
+    """End-to-end negative control: force a hole through the REAL
+    trainer artifacts and assert the audit sees it (guards against the
+    audit silently auditing the wrong tree)."""
+    import os
+
+    from unicore_tpu.analysis.scenarios import (
+        build_bert_scenario,
+        restore_globals,
+        snapshot_globals,
+    )
+    from unicore_tpu.analysis.trace_audit import audit_sharding_coverage
+
+    snap = snapshot_globals()
+    try:
+        trainer, samples, _ = build_bert_scenario(
+            os.path.join(_repo_root(), "examples", "bert"),
+            {"fsdp_size": 2}, jax.devices()[:8],
+        )
+        art = trainer.trace_train_step(samples)
+        # sabotage: claim every leaf is replicated
+        rep = jax.sharding.NamedSharding(
+            trainer.mesh, jax.sharding.PartitionSpec()
+        )
+        broken = jax.tree_util.tree_map(lambda _: rep,
+                                        art["state_shardings"])
+        found = audit_sharding_coverage(trainer.mesh, broken, art["state"])
+        assert "UL005" in rules_of(found)
+    finally:
+        restore_globals(snap)
+
+
+def test_cli_module_runs_lint_only():
+    proc = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis", "--no-trace", "-q"],
+        cwd=_repo_root(), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_json_report_and_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(x):\n    return x.block_until_ready()\n"
+    )
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis", "--no-trace", "-q",
+         "--no-baseline", "--lint-root", str(bad), "--json", str(out)],
+        cwd=_repo_root(), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1
+    report = json.loads(out.read_text())
+    assert report["counts"]["new"] == 1
+    assert report["new_findings"][0]["rule"] == "UL104"
+
+
+# ---------------------------------------------------------------------
+# satellite: dropout identity/full-drop quantization warning
+# ---------------------------------------------------------------------
+
+def test_dropout_warns_once_on_identity_quantization(caplog):
+    import importlib
+
+    dropout_mod = importlib.import_module("unicore_tpu.ops.dropout")
+
+    dropout_mod._warned_rates.clear()
+    x = jnp.ones((8,))
+    rng = jax.random.PRNGKey(0)
+    with caplog.at_level("WARNING", logger=dropout_mod.__name__):
+        out = dropout_mod.dropout(x, 0.001, rng)  # quantizes to identity
+        dropout_mod.dropout(x, 0.001, rng)        # second call: no new warn
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    warns = [r for r in caplog.records if "quantizes" in r.message]
+    assert len(warns) == 1
+
+
+def test_dropout_strict_raises_on_dead_rate():
+    import importlib
+
+    dropout_mod = importlib.import_module("unicore_tpu.ops.dropout")
+
+    x = jnp.ones((8,))
+    rng = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError, match="quantizes"):
+        dropout_mod.dropout(x, 0.9995, rng, strict=True)
+    # representable rates never warn or raise
+    dropout_mod.dropout(x, 0.1, rng, strict=True)
+
+
+def test_dropout_zero_and_one_rates_stay_silent(caplog):
+    import importlib
+
+    dropout_mod = importlib.import_module("unicore_tpu.ops.dropout")
+
+    dropout_mod._warned_rates.clear()
+    x = jnp.ones((8,))
+    rng = jax.random.PRNGKey(0)
+    with caplog.at_level("WARNING", logger=dropout_mod.__name__):
+        dropout_mod.dropout(x, 0.0, rng)
+        out = dropout_mod.dropout(x, 1.0, rng)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros_like(x))
+    assert [r for r in caplog.records if "quantizes" in r.message] == []

@@ -99,8 +99,10 @@ def test_ulysses_matches_full(rng, mesh, qkv, causal):
     q, k, v = qkv
     from jax.sharding import PartitionSpec as P
 
+    from unicore_tpu.parallel._compat import shard_map
+
     spec = P(None, "seq", None, None)
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         lambda q_, k_, v_: ulysses_attention(
             q_, k_, v_, axis_name="seq", causal=causal
         ),

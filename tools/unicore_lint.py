@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Thin launcher for the static-analysis subsystem.
+
+Equivalent to ``python -m unicore_tpu.analysis``; exists so the tool is
+discoverable next to the other repo tools and runnable from a checkout
+without installing the package.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from unicore_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

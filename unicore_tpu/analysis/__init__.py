@@ -1,0 +1,27 @@
+"""unicore-lint: static analysis that catches perf/correctness hazards
+at trace time, before they reach a bench run.
+
+Two passes (see docs/static_analysis.md):
+
+- **trace audit** (:mod:`.trace_audit`): trace + lower the REAL jitted
+  train step (no execution) and walk the jaxpr/lowered module for
+  upcast leaks, O(T^2) materializations, donation misses, host
+  callbacks, fp64 leaks, and fsdp/tensor sharding holes.
+- **source lint** (:mod:`.source_lint`): AST rules for the repo's
+  idioms — jit-without-donation on train steps, numpy inside jit,
+  dataset RNG outside the (seed, epoch, index) derivation, blocking
+  host syncs, and dropout rates the uint8 keep-draw quantizes away.
+
+Run ``python -m unicore_tpu.analysis --config examples/bert``.
+
+Kept import-light: jax loads only when a trace audit actually runs, so
+``--cpu-devices`` can still provision the virtual platform first.
+"""
+
+from unicore_tpu.analysis.findings import Finding  # noqa: F401
+
+
+def main(argv=None):
+    from unicore_tpu.analysis.cli import main as _main
+
+    return _main(argv)

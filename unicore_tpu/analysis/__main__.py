@@ -1,0 +1,5 @@
+import sys
+
+from unicore_tpu.analysis.cli import main
+
+sys.exit(main())

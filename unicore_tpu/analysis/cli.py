@@ -1,0 +1,175 @@
+"""``python -m unicore_tpu.analysis`` — the unicore-lint entry point.
+
+Runs both passes and reports machine-readable JSON plus human text:
+
+  Pass 1 (trace audit)   --config examples/bert [--cpu-devices 8]
+  Pass 2 (source lint)   on unicore_tpu/ unicore_tpu_cli/ examples/
+
+Exit code 0 when no findings outside the baseline, 1 otherwise.  CI
+pins the baseline (``tools/lint_baseline.json``) so only NEW findings
+fail; ``--write-baseline`` regenerates it after an accepted change.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_LINT_ROOTS = ("unicore_tpu", "unicore_tpu_cli", "examples")
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+
+def _anchor_dir():
+    """Directory the cwd-relative defaults resolve against: the cwd when
+    it looks like the repo checkout, else the checkout this package was
+    imported from (two levels up).  Running the tool from elsewhere must
+    not silently lint an empty set and report 'clean'."""
+    if any(os.path.isdir(r) for r in DEFAULT_LINT_ROOTS):
+        return os.getcwd()
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m unicore_tpu.analysis",
+        description="unicore-lint: trace audit + source lint",
+    )
+    p.add_argument(
+        "--config", metavar="DIR",
+        help="example plugin dir to trace-audit (e.g. examples/bert); "
+             "omit to skip the trace audit",
+    )
+    p.add_argument(
+        "--cpu-devices", type=int, default=0, metavar="N",
+        help="force a virtual N-device CPU platform (the 8-device dryrun "
+             "mesh CI uses); must be set before jax initializes",
+    )
+    p.add_argument(
+        "--lint-root", action="append", default=None, metavar="PATH",
+        help=f"roots for the source lint (default: "
+             f"{' '.join(DEFAULT_LINT_ROOTS)})",
+    )
+    p.add_argument("--no-lint", action="store_true",
+                   help="skip Pass 2 (source lint)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip Pass 1 (trace audit) even with --config")
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline/suppression file (default: {DEFAULT_BASELINE} "
+             f"when present)",
+    )
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline "
+                        "file and exit 0")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="also write the report as JSON")
+    p.add_argument(
+        "--big-mib", type=int, default=None, metavar="MIB",
+        help="override the UL002 absolute buffer budget (MiB)",
+    )
+    p.add_argument(
+        "--pedantic", action="store_true",
+        help="UL001 also flags fp32 elementwise chains seeded by "
+             "bf16->f32 converts (noisy: deliberate fp32 islands like "
+             "LayerNorm stats and optimizer math match the pattern)",
+    )
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress progress logging")
+    return p
+
+
+def _provision_cpu_devices(n):
+    """Force an n-device virtual CPU platform.  Must run before jax
+    initializes a backend; the dev image may register a TPU plugin from
+    sitecustomize, so the env var alone is not enough (same recipe as
+    tests/conftest.py)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    log = (lambda *a: None) if args.quiet else (
+        lambda *a: print("unicore-lint:", *a, file=sys.stderr)
+    )
+
+    findings = []
+    trace_reports = []
+
+    if args.config and not args.no_trace:
+        if args.cpu_devices:
+            _provision_cpu_devices(args.cpu_devices)
+        from unicore_tpu.analysis.scenarios import audit_bert_config
+
+        thresholds = {"pedantic": args.pedantic}
+        if args.big_mib is not None:
+            thresholds["big_bytes"] = args.big_mib << 20
+        got, trace_reports = audit_bert_config(
+            args.config, thresholds=thresholds, log=log,
+            n_devices=args.cpu_devices or None,
+        )
+        findings.extend(got)
+        for r in trace_reports:
+            if "skipped" in r:
+                log(f"variant {r['variant']}: SKIPPED ({r['skipped']})")
+
+    anchor = _anchor_dir()
+    if not args.no_lint:
+        from unicore_tpu.analysis.source_lint import lint_paths
+
+        roots = args.lint_root or [
+            os.path.join(anchor, r) for r in DEFAULT_LINT_ROOTS
+            if os.path.isdir(os.path.join(anchor, r))
+        ]
+        if not roots:
+            print(
+                f"unicore-lint: error: no lint roots found under {anchor} "
+                f"(pass --lint-root or run from the repo checkout)",
+                file=sys.stderr,
+            )
+            return 2
+        log("linting", ", ".join(roots))
+        findings.extend(lint_paths(roots, rel_to=anchor))
+
+    from unicore_tpu.analysis.findings import (
+        load_baseline,
+        render_report,
+        report_json,
+        split_baselined,
+        write_baseline,
+    )
+
+    baseline_path = args.baseline or os.path.join(anchor, DEFAULT_BASELINE)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"unicore-lint: wrote {len(findings)} suppression(s) to "
+              f"{baseline_path}")
+        return 0
+
+    fps = set() if args.no_baseline else load_baseline(baseline_path)
+    new, suppressed = split_baselined(findings, fps)
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(
+                report_json(new, suppressed,
+                            extra={"trace": trace_reports}),
+                fh, indent=2,
+            )
+            fh.write("\n")
+    print(render_report(new, suppressed))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

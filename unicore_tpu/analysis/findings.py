@@ -1,0 +1,123 @@
+"""Finding model, baseline/suppression files, and report rendering.
+
+A :class:`Finding` is one hazard located either in source (``file:line``)
+or in a traced program (``trace:<scenario>``).  Baselines let CI fail on
+NEW findings only: the checked-in file (``tools/lint_baseline.json``)
+records fingerprints of accepted findings; anything not in it fails the
+run.  Fingerprints deliberately exclude line numbers so unrelated edits
+above a finding don't churn the baseline.
+"""
+
+import hashlib
+import json
+import re
+from dataclasses import asdict, dataclass
+
+# severity ordering for report sorting
+_SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str        # "UL001"
+    name: str        # "upcast-leak"
+    severity: str    # "error" | "warning"
+    location: str    # "path/to/file.py:123" or "trace:<scenario>"
+    message: str     # human sentence, stable across runs
+
+    @property
+    def fingerprint(self):
+        """Stable id: rule + line-number-stripped location + message."""
+        loc = re.sub(r":\d+$", "", self.location)
+        digest = hashlib.sha1(
+            f"{self.rule}|{loc}|{self.message}".encode()
+        ).hexdigest()
+        return digest[:16]
+
+    def to_dict(self):
+        d = asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+    def render(self):
+        return f"{self.location}: {self.severity} {self.rule} " \
+               f"[{self.name}] {self.message}"
+
+
+def sort_findings(findings):
+    return sorted(
+        findings,
+        key=lambda f: (
+            _SEVERITIES.index(f.severity) if f.severity in _SEVERITIES
+            else len(_SEVERITIES),
+            f.location, f.rule,
+        ),
+    )
+
+
+def load_baseline(path):
+    """Fingerprint set from a baseline file; empty set if absent."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return set()
+    return {e["fingerprint"] for e in data.get("suppressions", [])}
+
+
+def write_baseline(path, findings):
+    """Write every finding as an accepted suppression (sorted, stable)."""
+    entries = [
+        {
+            "rule": f.rule,
+            "name": f.name,
+            "location": re.sub(r":\d+$", "", f.location),
+            "message": f.message,
+            "fingerprint": f.fingerprint,
+        }
+        for f in sort_findings(findings)
+    ]
+    # one entry per fingerprint (several same-named findings in one file
+    # share one suppression by design — see docs/static_analysis.md)
+    seen, unique = set(), []
+    for e in entries:
+        if e["fingerprint"] not in seen:
+            seen.add(e["fingerprint"])
+            unique.append(e)
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "suppressions": unique}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_baselined(findings, baseline_fps):
+    """(new, suppressed) partition against a fingerprint set."""
+    new, suppressed = [], []
+    for f in findings:
+        (suppressed if f.fingerprint in baseline_fps else new).append(f)
+    return new, suppressed
+
+
+def report_json(new, suppressed, extra=None):
+    out = {
+        "new_findings": [f.to_dict() for f in sort_findings(new)],
+        "suppressed_findings": [
+            f.to_dict() for f in sort_findings(suppressed)
+        ],
+        "counts": {"new": len(new), "suppressed": len(suppressed)},
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def render_report(new, suppressed):
+    lines = []
+    for f in sort_findings(new):
+        lines.append(f.render())
+    if suppressed:
+        lines.append(f"({len(suppressed)} baselined finding(s) suppressed)")
+    if not new:
+        lines.append("unicore-lint: clean (no new findings)")
+    else:
+        lines.append(f"unicore-lint: {len(new)} new finding(s)")
+    return "\n".join(lines)

@@ -1,0 +1,215 @@
+"""Audit scenarios: build a real Trainer over a real mesh for tracing.
+
+The flagship scenario is the BERT MLM example (``examples/bert``) at
+tiny shapes — the shapes only size the trace, and the structural
+hazards the audit hunts (promotion leaks, donation, sharding holes,
+callbacks, fp64) are shape-independent, so a seconds-long CPU trace
+covers the program a v5e pod would compile.  The exception is UL002
+(giant-intermediate), whose BYTE thresholds cannot fire at audit
+shapes — and cannot simply be audited at a representative T either,
+because on the CPU audit host the flash dispatch never engages and a
+large-T trace would legitimately contain the materialized O(T^2)
+buffers the TPU program avoids; UL002 in this gate is a budget
+tripwire for egregious absolute materializations, and real-shape
+sweeps should pass ``--big-mib`` against a TPU-backed trace.  Mesh
+variants mirror ``__graft_entry__``'s 8-device dryrun so the TP/FSDP
+sharding-coverage rules see the axes that bit round 4.
+"""
+
+import os
+import sys
+from argparse import Namespace
+
+import numpy as np
+
+# (name, trainer-arg overrides, min devices)
+MESH_VARIANTS = (
+    ("dp", {}, 1),
+    ("fsdp2", {"fsdp_size": 2}, 2),
+    ("tp2", {"tensor_parallel_size": 2}, 2),
+    ("seq2", {"seq_parallel_size": 2}, 2),
+    ("tp2_fsdp2", {"tensor_parallel_size": 2, "fsdp_size": 2}, 4),
+)
+
+
+def base_args(**overrides):
+    args = Namespace(
+        seed=1, update_freq=[2], clip_norm=1.0, ema_decay=-1.0,
+        fp16=False, bf16=True, bf16_sr=False,
+        optimizer="adam", lr=[1e-3], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.01,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=10, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+def _load_bert_model(example_dir, vocab, *, layers, dim, ffn, heads, seq):
+    example_dir = os.path.abspath(example_dir)
+    if not os.path.isfile(os.path.join(example_dir, "model.py")):
+        raise FileNotFoundError(
+            f"--config {example_dir!r}: no model.py there (expected the "
+            f"examples/bert plugin directory)"
+        )
+    # Reuse the module if ANY prior import already executed this file —
+    # the plugin's task.py registers "bert" at import time, and a second
+    # execution under a different module identity (tests import it as
+    # "examples.bert.model", --user-dir as "bert.model") would raise a
+    # duplicate-registration error from the registry.
+    import importlib
+
+    target = os.path.join(example_dir, "model.py")
+    module = next(
+        (m for m in list(sys.modules.values())
+         if getattr(m, "__file__", None)
+         and os.path.abspath(m.__file__) == target
+         and hasattr(m, "BertModel")),
+        None,
+    )
+    if module is None:
+        parent, name = os.path.split(example_dir)
+        grandparent = os.path.dirname(parent)
+        candidates = [(parent, f"{name}.model")]
+        if os.path.basename(parent) == "examples":
+            # prefer the identity the test suite uses for fresh loads
+            candidates.insert(0, (grandparent, f"examples.{name}.model"))
+        err = None
+        for path, dotted in candidates:
+            sys.path.insert(0, path)
+            try:
+                module = importlib.import_module(dotted)
+                break
+            except ImportError as e:
+                err = e
+            finally:
+                sys.path.pop(0)
+        if module is None:
+            raise ImportError(
+                f"could not import the bert plugin from {example_dir}"
+            ) from err
+    return module.BertModel(
+        vocab_size=vocab, padding_idx=0, encoder_layers=layers,
+        encoder_embed_dim=dim, encoder_ffn_embed_dim=ffn,
+        encoder_attention_heads=heads, max_seq_len=seq,
+        emb_dropout=0.1, dropout=0.1, attention_dropout=0.1,
+        activation_dropout=0.0, post_ln=True,
+    )
+
+
+def build_bert_scenario(example_dir, overrides=None, devices=None, *,
+                        seq=16, layers=2, dim=64, ffn=128, heads=4,
+                        batch_size=8):
+    """(trainer, samples, meta) for one mesh variant of the bert config.
+
+    Installs the variant's mesh as the cached global mesh (the Trainer
+    consults the cache); callers restore via :func:`restore_globals`.
+    """
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.distributed import utils as dist_utils
+    from unicore_tpu.losses.masked_lm import MaskedLMLoss
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    args = base_args(**(overrides or {}))
+
+    # 59 + [MASK] + 4 base specials = 64 symbols: even vocab so the
+    # vocab-parallel embedding sharding engages under tensor variants
+    d = Dictionary()
+    for i in range(59):
+        d.add_symbol(f"tok{i}")
+    mask_idx = d.add_symbol("[MASK]", is_special=True)
+
+    class _Task(UnicoreTask):
+        def __init__(self, a):
+            super().__init__(a)
+            self.dictionary = d
+
+    mesh = dist_utils.get_mesh(args, devices=devices)
+    dist_utils.reset_mesh(mesh)
+    task = _Task(args)
+    model = _load_bert_model(
+        example_dir, len(d), layers=layers, dim=dim, ffn=ffn, heads=heads,
+        seq=seq,
+    )
+    loss = MaskedLMLoss(task)
+    trainer = Trainer(args, task, model, loss)
+
+    rng = np.random.RandomState(0)
+    bsz = max(batch_size, mesh.devices.size)
+
+    def batch():
+        toks = rng.randint(4, len(d) - 1, size=(bsz, seq)).astype(np.int64)
+        tgt = np.full_like(toks, d.pad())
+        mask = rng.rand(bsz, seq) < 0.3
+        tgt[mask] = toks[mask]
+        toks[mask] = mask_idx
+        return {"net_input": {"src_tokens": toks}, "target": tgt}
+
+    samples = [batch(), batch()]
+    meta = {"seq_len": seq, "mesh": dict(
+        zip(mesh.axis_names, mesh.devices.shape)
+    )}
+    return trainer, samples, meta
+
+
+def snapshot_globals():
+    """Capture the process-global mesh + parallel contexts scenarios
+    mutate, so tests/CLI runs leave no trace."""
+    from unicore_tpu.distributed import utils as dist_utils
+
+    return dist_utils._MESH
+
+
+def restore_globals(snapshot):
+    from unicore_tpu import parallel
+    from unicore_tpu.distributed import utils as dist_utils
+
+    parallel.disable_sequence_parallel()
+    parallel.disable_tensor_parallel()
+    dist_utils.reset_mesh(snapshot)
+
+
+def audit_bert_config(example_dir, *, variants=None, n_devices=None,
+                      thresholds=None, log=None):
+    """Run the Pass-1 trace audit over the bert config's mesh variants.
+
+    Returns (findings, reports): reports carries per-variant metadata
+    (mesh shape, whether it ran or was skipped for lack of devices).
+    """
+    import jax
+
+    from unicore_tpu.analysis.trace_audit import audit_trainer
+
+    avail = jax.devices()
+    if n_devices is None:
+        n_devices = min(8, len(avail))
+    devices = avail[:n_devices]
+    findings, reports = [], []
+    snap = snapshot_globals()
+    try:
+        for name, overrides, min_dev in (variants or MESH_VARIANTS):
+            if len(devices) < min_dev or len(devices) % max(min_dev, 1):
+                reports.append({"variant": name, "skipped":
+                                f"needs {min_dev} devices, have "
+                                f"{len(devices)}"})
+                continue
+            trainer, samples, meta = build_bert_scenario(
+                example_dir, overrides, devices
+            )
+            ctx = f"bert/{name}"
+            if log:
+                log(f"tracing {ctx} on mesh {meta['mesh']}")
+            got, art = audit_trainer(
+                trainer, samples, context=ctx, seq_len=meta["seq_len"],
+                thresholds=thresholds,
+            )
+            findings.extend(got)
+            reports.append({"variant": name, "mesh": meta["mesh"],
+                            "findings": len(got)})
+    finally:
+        restore_globals(snap)
+    return findings, reports

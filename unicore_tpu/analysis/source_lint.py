@@ -1,0 +1,375 @@
+"""Pass 2: repo-specific AST lint over Python sources.
+
+Rules (see docs/static_analysis.md for rationale and incidents):
+
+- UL101 jit-missing-donation: ``jax.jit`` on a train-step-shaped
+  function without ``donate_argnums``/``donate_argnames``.
+- UL102 numpy-in-jit: host numpy calls inside a jitted function (each
+  one constant-folds at trace time at best, breaks tracing at worst).
+- UL103 unseeded-dataset-rng: dataset code drawing from global RNG
+  state outside the per-(seed, epoch, index) ``numpy_seed`` idiom —
+  epoch resume and multi-worker determinism silently break.
+- UL104 blocking-fetch: ``.block_until_ready()`` / ``.item()`` in
+  library code outside the stats slow path (each is a host sync that
+  serializes dispatch).
+- UL105 dropout-dead-rate: a literal dropout rate that quantizes to
+  exact identity or full drop at the uint8 keep resolution of
+  ``ops/dropout.py`` (rates within 1/512 of 0 or 1).
+
+Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
+ids, or ``all``) to the flagged line.
+"""
+
+import ast
+import os
+import re
+
+from unicore_tpu.analysis.findings import Finding
+
+_SUPPRESS_RE = re.compile(r"#\s*unicore-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# UL102: numpy attributes that are metadata-only (safe inside jit)
+_NUMPY_META_OK = {"prod", "dtype", "ndim", "issubdtype", "result_type",
+                  "promote_types", "broadcast_shapes"}
+
+# UL103: global-state numpy RNG draws
+_NP_GLOBAL_RNG = {
+    "rand", "randn", "randint", "random", "choice", "permutation",
+    "shuffle", "uniform", "normal", "random_sample", "beta", "binomial",
+    "poisson", "multinomial", "bytes", "sample", "ranf",
+}
+# UL103: the numpy_seed idiom's own plumbing (allowed anywhere)
+_NP_RNG_PLUMBING = {"get_state", "set_state", "seed"}
+# UL103: stdlib random draws (numpy_seed does NOT scope these)
+_PY_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+}
+# UL103: explicitly-seeded generator constructors (need a seed argument)
+_RNG_CONSTRUCTORS = {"RandomState", "default_rng", "Generator",
+                     "SeedSequence"}
+
+# UL104: allowed path fragments — the stats slow path (meter formatting)
+_BLOCKING_OK_PATHS = ("logging" + os.sep,)
+
+
+def _attr_chain(node):
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleLint(ast.NodeVisitor):
+    def __init__(self, path, source, *, dataset_file, lines):
+        self.path = path
+        self.dataset_file = dataset_file
+        self.lines = lines
+        self.findings = []
+        # alias tracking: import numpy as np / import random as rnd
+        self.np_aliases = {"numpy"}
+        self.random_aliases = set()
+        self.jax_aliases = {"jax"}
+        self.jitted_names = set()
+        self._with_seed_depth = 0
+        self._tree = ast.parse(source, filename=path)
+        self._collect_imports_and_jit_targets()
+
+    # -- setup ---------------------------------------------------------
+
+    def _collect_imports_and_jit_targets(self):
+        for node in ast.walk(self._tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if alias.name == "numpy":
+                        self.np_aliases.add(name)
+                    elif alias.name == "random":
+                        self.random_aliases.add(name)
+                    elif alias.name == "jax":
+                        self.jax_aliases.add(name)
+            elif isinstance(node, ast.Call) and self._is_jax_jit(node.func):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.jitted_names.add(node.args[0].id)
+
+    def _is_jax_jit(self, func):
+        chain = _attr_chain(func)
+        if chain is None:
+            return False
+        head, _, tail = chain.rpartition(".")
+        return tail == "jit" and (head in self.jax_aliases or head == "")
+
+    # -- emit ----------------------------------------------------------
+
+    def _suppressed(self, rule, lineno):
+        if 1 <= lineno <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[lineno - 1])
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                return rule in ids or "all" in ids
+        return False
+
+    def emit(self, rule, name, severity, node, message):
+        if self._suppressed(rule, node.lineno):
+            return
+        self.findings.append(Finding(
+            rule, name, severity, f"{self.path}:{node.lineno}", message
+        ))
+
+    # -- UL101 / UL102 -------------------------------------------------
+
+    def _emit_missing_donation(self, node, target_name):
+        self.emit(
+            "UL101", "jit-missing-donation", "error", node,
+            f"jax.jit({target_name}) without donate_argnums — a "
+            f"train step that does not donate its state keeps two "
+            f"copies of params+optimizer state in HBM",
+        )
+
+    def _check_jit_call(self, node):
+        kwargs = {kw.arg for kw in node.keywords}
+        target = node.args[0] if node.args else None
+        target_name = None
+        if isinstance(target, ast.Name):
+            target_name = target.id
+        elif isinstance(target, ast.Attribute):
+            target_name = target.attr
+        hot = target_name is not None and "train" in target_name.lower()
+        if hot and not ({"donate_argnums", "donate_argnames"} & kwargs):
+            self._emit_missing_donation(node, target_name)
+
+    def _check_jit_decorators(self, fn):
+        """UL101 for the decorator spellings: ``@jax.jit`` and
+        ``@partial(jax.jit, ...)`` (the call form is handled by
+        :meth:`_check_jit_call`)."""
+        if "train" not in fn.name.lower():
+            return
+        for dec in fn.decorator_list:
+            if self._is_jax_jit(dec):
+                # bare @jax.jit carries no kwargs at all
+                self._emit_missing_donation(dec, fn.name)
+                continue
+            if not isinstance(dec, ast.Call):
+                continue
+            kwargs = {kw.arg for kw in dec.keywords}
+            donated = {"donate_argnums", "donate_argnames"} & kwargs
+            chain = _attr_chain(dec.func)
+            is_partial_jit = (
+                chain and chain.split(".")[-1] == "partial"
+                and dec.args and self._is_jax_jit(dec.args[0])
+            )
+            if (self._is_jax_jit(dec.func) or is_partial_jit) and not donated:
+                self._emit_missing_donation(dec, fn.name)
+
+    def _check_numpy_in_jit(self, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain is None:
+                continue
+            head, _, tail = chain.rpartition(".")
+            root = head.split(".")[0] if head else ""
+            if root in self.np_aliases and tail not in _NUMPY_META_OK:
+                self.emit(
+                    "UL102", "numpy-in-jit", "error", node,
+                    f"host numpy call '{chain}' inside jitted function "
+                    f"'{fn.name}' — it runs at trace time (silent "
+                    f"constant folding) or fails on tracers; use jnp",
+                )
+
+    def _fn_is_jitted(self, fn):
+        if fn.name in self.jitted_names:
+            return True
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if self._is_jax_jit(target):
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+            if isinstance(dec, ast.Call):
+                chain = _attr_chain(dec.func)
+                if chain and chain.split(".")[-1] == "partial" and dec.args:
+                    if self._is_jax_jit(dec.args[0]):
+                        return True
+        return False
+
+    # -- UL103 ---------------------------------------------------------
+
+    def _is_numpy_seed_with(self, node):
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                chain = _attr_chain(expr.func)
+                if chain and chain.split(".")[-1] == "numpy_seed":
+                    return True
+        return False
+
+    def _check_dataset_rng(self, node):
+        chain = _attr_chain(node.func)
+        if chain is None:
+            return
+        parts = chain.split(".")
+        head, tail = parts[0], parts[-1]
+        # numpy global-state draws: np.random.<draw>(...)
+        if (head in self.np_aliases and len(parts) >= 3
+                and parts[-2] == "random"):
+            if tail in _NP_RNG_PLUMBING:
+                return
+            if tail in _RNG_CONSTRUCTORS:
+                if not node.args and not node.keywords:
+                    self.emit(
+                        "UL103", "unseeded-dataset-rng", "error", node,
+                        f"'{chain}()' without a seed in dataset code — "
+                        f"samples become irreproducible across "
+                        f"epochs/workers; derive the seed from "
+                        f"(seed, epoch, index)",
+                    )
+                return
+            if tail in _NP_GLOBAL_RNG and self._with_seed_depth == 0:
+                self.emit(
+                    "UL103", "unseeded-dataset-rng", "error", node,
+                    f"'{chain}' draws from numpy's GLOBAL rng outside a "
+                    f"'with data_utils.numpy_seed(seed, epoch, index)' "
+                    f"block — bypasses the per-(seed, epoch, index) "
+                    f"derivation idiom (resume/worker determinism breaks)",
+                )
+            return
+        # stdlib random: numpy_seed does not scope it at all
+        if head in self.random_aliases and tail in _PY_RANDOM_FNS:
+            self.emit(
+                "UL103", "unseeded-dataset-rng", "error", node,
+                f"stdlib '{chain}' in dataset code — 'numpy_seed' does "
+                f"not seed the stdlib rng; use the numpy generator "
+                f"derived from (seed, epoch, index)",
+            )
+
+    # -- UL104 / UL105 -------------------------------------------------
+
+    def _check_blocking(self, node):
+        if any(frag in self.path for frag in _BLOCKING_OK_PATHS):
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        if attr == "block_until_ready":
+            self.emit(
+                "UL104", "blocking-fetch", "error", node,
+                "'.block_until_ready()' in library code — a host sync "
+                "that serializes dispatch; only bench/test harnesses "
+                "should block (use the stats slow path for logging)",
+            )
+        elif attr == "item" and not node.args:
+            self.emit(
+                "UL104", "blocking-fetch", "warning", node,
+                "'.item()' in library code — device->host sync per call; "
+                "batch fetches through jax.device_get on the stats slow "
+                "path instead",
+            )
+
+    def _check_dropout_rate(self, node):
+        chain = _attr_chain(node.func)
+        if chain is None or chain.split(".")[-1] != "dropout":
+            return
+        candidates = []
+        if len(node.args) >= 2:
+            candidates.append(node.args[1])
+        candidates.extend(
+            kw.value for kw in node.keywords
+            if kw.arg in ("rate", "dropout_prob", "p")
+        )
+        for arg in candidates:
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, (int, float))):
+                continue
+            r = float(arg.value)
+            # EXACTLY the op's quantization (ops/dropout.py): a dead
+            # band re-derivation would disagree at the r = 1/512
+            # boundary, where round() already banker's-rounds q to 256
+            q = int(round((1.0 - r) * 256.0))
+            dead = (q >= 256 and r > 0.0) or (q <= 0 and r < 1.0)
+            if dead:
+                self.emit(
+                    "UL105", "dropout-dead-rate", "error", node,
+                    f"dropout rate {r!r} quantizes to "
+                    f"{'identity' if r < 0.5 else 'full drop'} at the "
+                    f"uint8 q/256 keep resolution — the requested rate "
+                    f"is silently not applied (ops/dropout.py)",
+                )
+
+    # -- traversal -----------------------------------------------------
+
+    def visit_With(self, node):
+        scoped = self._is_numpy_seed_with(node)
+        if scoped:
+            self._with_seed_depth += 1
+        self.generic_visit(node)
+        if scoped:
+            self._with_seed_depth -= 1
+
+    def visit_Call(self, node):
+        if self._is_jax_jit(node.func):
+            self._check_jit_call(node)
+        if self.dataset_file:
+            self._check_dataset_rng(node)
+        self._check_blocking(node)
+        self._check_dropout_rate(node)
+        self.generic_visit(node)
+
+    def _visit_functions(self):
+        for node in ast.walk(self._tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._fn_is_jitted(node):
+                    self._check_numpy_in_jit(node)
+                self._check_jit_decorators(node)
+
+    def run(self):
+        self.visit(self._tree)
+        self._visit_functions()
+        return self.findings
+
+
+def _is_dataset_file(path):
+    norm = path.replace(os.sep, "/")
+    return ("/data/" in norm or norm.endswith("_dataset.py")
+            or "dataset" in os.path.basename(norm))
+
+
+def lint_file(path, *, rel_to=None):
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, rel_to) if rel_to else path
+    try:
+        linter = _ModuleLint(
+            rel, source,
+            dataset_file=_is_dataset_file(rel),
+            lines=source.splitlines(),
+        )
+    except SyntaxError as e:
+        return [Finding(
+            "UL100", "syntax-error", "error", f"{rel}:{e.lineno or 0}",
+            f"file does not parse: {e.msg}",
+        )]
+    return linter.run()
+
+
+def lint_paths(roots, *, rel_to=None, exclude=("__pycache__",)):
+    """Lint every .py file under ``roots`` (files or directories)."""
+    findings = []
+    for root in roots:
+        if os.path.isfile(root):
+            findings.extend(lint_file(root, rel_to=rel_to))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in exclude]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn), rel_to=rel_to)
+                    )
+    return findings

@@ -99,6 +99,7 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
         None if bias is None else bias.shape[2],
         None if bias is None else bias.dtype,
         has_pad, causal, dropout_on, heads=q.shape[2],
+        bias_heads=None if bias is None else bias.shape[1],
     )
 
 

@@ -70,7 +70,8 @@ def group_flash_attention(q, k, v, pair_bias, mask, dropout, deterministic,
     if not fa.probe_ok(q.dtype, T, T, D,
                        None if bias is None else bias.shape[2],
                        None if bias is None else bias.dtype,
-                       mask is not None, False, dropout_on, heads=H):
+                       mask is not None, False, dropout_on, heads=H,
+                       bias_heads=None if bias is None else bias.shape[1]):
         return None
     rng = make_rng("dropout") if dropout_on else None
     kpm = None

@@ -57,12 +57,24 @@ def use_pallas():
 def pallas_interpret():
     """Interpret-mode setting for pallas_call: off-TPU (CPU tests) return
     TPU InterpretParams so TPU-specific primitives (prng_seed,
-    stochastic_round, ...) are emulated; on TPU compile normally."""
+    stochastic_round, ...) are emulated; on TPU compile normally.  On a
+    jax without InterpretParams the boolean interpret mode is the
+    closest equivalent (TPU primitive emulation landed there too)."""
     if _on_tpu():
         return False
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.InterpretParams()
+    cls = getattr(pltpu, "InterpretParams", None)
+    return cls() if cls is not None else True
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the rename (older jax releases
+    call the same dataclass ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
 
 
 _TIMED_CACHE = {}

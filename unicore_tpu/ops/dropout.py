@@ -13,20 +13,52 @@ quantized probability, so E[dropout(x)] == x holds precisely — only the
 rate granularity differs from the float path, which is immaterial at
 training rates (the reference's own CUDA PRNG draws a different stream
 anyway).  Rates without a representable q (< 1/512 from 0 or 1) fall
-back to identity / full drop at the caller's rate.
+back to identity / full drop at the caller's rate — warned once per
+distinct rate, or raised under ``UNICORE_TPU_STRICT_DROPOUT=1`` /
+``strict=True`` (a nonzero rate that silently regularizes nothing is a
+misconfiguration, not a request).
 """
+
+import logging
+import os
 
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger(__name__)
 
-def dropout(x, rate, rng):
+_warned_rates = set()
+
+
+def _quantization_escape(rate, q, effect, strict):
+    if strict is None:
+        strict = os.environ.get("UNICORE_TPU_STRICT_DROPOUT", "") == "1"
+    msg = (
+        f"dropout rate {rate!r} quantizes to {effect} at the q/256 keep "
+        f"resolution (q={q}); the requested rate is not representable — "
+        f"use a rate of at least 1/512 from 0 and 1, or the float path"
+    )
+    if strict:
+        raise ValueError(msg)
+    key = float(rate)
+    if key not in _warned_rates:
+        _warned_rates.add(key)
+        logger.warning(msg)
+
+
+def dropout(x, rate, rng, strict=None):
     """Apply inverted dropout to ``x`` (training path; callers gate on
     their own ``deterministic`` flag and rate > 0)."""
-    q = int(round((1.0 - float(rate)) * 256.0))
+    rate = float(rate)
+    q = int(round((1.0 - rate) * 256.0))
     if q >= 256:
+        if rate > 0.0:
+            _quantization_escape(rate, q, "exact identity (no dropout)",
+                                 strict)
         return x
     if q <= 0:
+        if rate < 1.0:
+            _quantization_escape(rate, q, "a full drop (all zeros)", strict)
         return jnp.zeros_like(x)
     keep = jax.random.bits(rng, x.shape, dtype=jnp.uint8) < jnp.uint8(q)
     scale = jnp.asarray(256.0 / q, x.dtype)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from unicore_tpu.ops.backend import pallas_interpret
+from unicore_tpu.ops.backend import pallas_interpret, tpu_compiler_params
 from unicore_tpu.ops.pallas.prng import keep_mask
 
 NEG_INF = -1e30
@@ -562,19 +562,23 @@ def _pick_blocks(tq, tk, bias_itemsize=0):
 
 
 def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
-             dropout_on, heads=1):
+             dropout_on, heads=1, bias_heads=None):
     """FAIL-OPEN compile probe for one flash config (round-2 lesson: a
     kernel that doesn't lower must fall back to the einsum path, not kill
     training).  Keyed on everything that affects Mosaic lowering — q/kv
     dtype, seq lens (they fix the block sizes), head dim, bias kind
     (``bias_q`` is None / 1 / tq — the bQ==1 sublane-1 block is its own
-    spec) and bias dtype, pad mask presence, causal, dropout.  The probe
-    shrinks the batch to 1 (grid size does not affect lowering) but
-    keeps the REAL head count: in the single-block regime the kernels
-    batch ``_pick_hb(heads, ...)`` heads per grid step with hb-times
-    larger blocks, so a heads=1 probe would compile a different (hb=1)
-    variant than production runs and the fail-open guarantee would be
-    void exactly where VMEM pressure is highest."""
+    spec), bias dtype AND bias head count (``bias_heads`` is 1 for a
+    head-broadcast bias, else the head count: ``_hb_specs`` lowers a
+    (1, 1, bQ, bk) block for bH == 1 vs (1, hb, bQ, bk) otherwise, so a
+    heads-dim probe would not cover a broadcastable attn_mask), pad mask
+    presence, causal, dropout.  The probe shrinks the batch to 1 (grid
+    size does not affect lowering) but keeps the REAL head count: in the
+    single-block regime the kernels batch ``_pick_hb(heads, ...)`` heads
+    per grid step with hb-times larger blocks, so a heads=1 probe would
+    compile a different (hb=1) variant than production runs and the
+    fail-open guarantee would be void exactly where VMEM pressure is
+    highest."""
     from unicore_tpu.ops.backend import kernel_probe_ok
 
     dtype = jnp.dtype(dtype)
@@ -584,9 +588,16 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
         0 if (bias_q is None or bias_q == 1) else jnp.dtype(bias_dtype).itemsize,
     )
     heads = heads if (tq == bq_ and tk == bk_) else 1  # hb only single-block
+    if bias_q is None:
+        bias_heads = None
+    else:
+        # normalize the same way heads is: the only spec distinction is
+        # broadcast (bH == 1) vs per-head (bH == heads), and after the
+        # multi-block heads->1 collapse both coincide at 1
+        bias_heads = 1 if (bias_heads is None or bias_heads == 1) else heads
     key = ("flash", dtype.name, tq, tk, d, bias_q,
            None if bias_dtype is None else bias_dtype.name,
-           has_pad, causal, dropout_on, heads)
+           has_pad, causal, dropout_on, heads, bias_heads)
 
     def build():
         q = jnp.zeros((1, tq, heads, d), dtype)
@@ -603,7 +614,7 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
 
             jax.jit(jax.grad(f, argnums=(0, 1))).lower(q, kv).compile()
         else:
-            bias = jnp.zeros((1, heads, bias_q, tk), bias_dtype)
+            bias = jnp.zeros((1, bias_heads, bias_q, tk), bias_dtype)
 
             def f(q, kv, bias):
                 o = flash_attention(q, kv, kv, bias=bias, **kw)
@@ -617,10 +628,13 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
 def kernel_self_check():
     """Compile-smoke the production-critical spec variants (used by
     ``tools/tpu_smoke.py`` and available for startup checks): BERT-like
-    bf16 bias+pad+dropout, the bQ==1 broadcast-bias block, and causal."""
+    bf16 per-head bias+pad+dropout, the head-broadcast (bH==1) bias
+    block, the bQ==1 broadcast-bias block, and causal."""
     return (
         probe_ok(jnp.bfloat16, 512, 512, 64, 512, jnp.bfloat16, True, False,
-                 True)
+                 True, heads=8, bias_heads=8)
+        and probe_ok(jnp.bfloat16, 512, 512, 64, 512, jnp.bfloat16, True,
+                     False, True, heads=8, bias_heads=1)
         and probe_ok(jnp.float32, 256, 256, 64, 1, jnp.float32, False, False,
                      False)
         and probe_ok(jnp.float32, 256, 256, 64, None, None, False, True,
@@ -748,7 +762,7 @@ def _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*args)
@@ -787,7 +801,7 @@ def _flash_fwd_hb(q, k, v, bias, pad, dropout_prob, seed, causal, scale,
             jax.ShapeDtypeStruct((bsz, heads, tq, 1), jnp.float32),
         ],
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024,  # see the backward's note
         ),
@@ -866,7 +880,7 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
                 pltpu.VMEM((tk, d), jnp.float32),
             ],
             interpret=pallas_interpret(),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary",
                                      "arbitrary"),
             ),
@@ -892,7 +906,7 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*(common_args + extra_args))
@@ -938,7 +952,7 @@ def _flash_bwd(dropout_prob, causal, scale, residuals, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*(common_args + extra_args))
@@ -1005,7 +1019,7 @@ def _dbias_pass(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
         out_shape=jax.ShapeDtypeStruct((heads, tq, tk), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -1105,7 +1119,7 @@ def _flash_bwd_fused(q, k, v, bias, pad, seed, lse, delta, g, dropout_prob,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=pallas_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             # the hb-batched working set legitimately exceeds the 16MB
             # default scoped-vmem (v5e has 128MB physical); measured

@@ -1,0 +1,40 @@
+"""jax version compatibility for the parallel modules.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the jax
+top level; support both so the ring/Ulysses paths run on the CI
+container's jax as well as current releases.
+"""
+
+import jax
+
+
+def shard_map(*args, **kwargs):
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(*args, **kwargs)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where available; the constant-folded
+    ``psum(1, axis)`` idiom on jax versions that predate it."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def vary(x, axes):
+    """Type ``x`` device-varying over ``axes`` for shard_map scan
+    carries.  pcast (current) -> pvary (its predecessor) -> identity
+    (versions before varying-type checking need no annotation)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        try:
+            return pcast(x, axes, to="varying")
+        except TypeError:
+            pass
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axes)
+    return x

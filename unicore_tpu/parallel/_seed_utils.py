@@ -13,7 +13,9 @@ def batch_shard_index(batch_axes):
     shard_map."""
     lin = 0
     for ax in (batch_axes or ()):
-        lin = lin * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        from ._compat import axis_size
+
+        lin = lin * axis_size(ax) + jax.lax.axis_index(ax)
     return lin
 
 

@@ -791,6 +791,37 @@ class Trainer:
             out = self._process_stats(*self._pending_stats.pop(0))
         return out
 
+    def trace_train_step(self, samples):
+        """AOT trace + lower the jitted train step WITHOUT executing it.
+
+        The static-analysis subsystem (``unicore_tpu.analysis``) audits
+        the returned artifacts: the jaxpr for upcast leaks / giant
+        intermediates / host callbacks, the lowered module's args_info
+        for donation coverage, and the state shardings for
+        fsdp/tensor-axis holes.  Shares the exact ``_make_train_step``
+        closure the runtime dispatch path jits — the audit sees the
+        program that trains, not a reconstruction — and the same AOT
+        ``lower()`` stage ``_dispatch_train_step`` uses for its
+        pre-flight ``memory_analysis()``.  No device execution happens
+        here beyond state init."""
+        if self.state is None:
+            self.init_state(samples[0])
+        batches, weights_np = self._stack_microbatches(samples)
+        if self._jit_train_step is None:
+            self._jit_train_step = self._make_train_step()
+        lr = jnp.float32(self.lr_scheduler.step_update(self.get_num_updates()))
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), self._dispatch_count or 0
+        )
+        args = (self.state, batches, jnp.asarray(weights_np), lr, rng)
+        traced = self._jit_train_step.trace(*args)
+        return {
+            "jaxpr": traced.jaxpr,
+            "lowered": traced.lower(),
+            "state_shardings": self._state_shardings,
+            "state": self.state,
+        }
+
     def _dispatch_train_step(self, state, batches, weights, lr, rng):
         """AOT-compile the train step (so its ``memory_analysis()`` can be
         checked against HBM BEFORE the first step executes — the §5.3
