@@ -304,10 +304,28 @@ def _microbench(out):
     import numpy as np
 
     from unicore_tpu import ops
+    from unicore_tpu.ops import tuning
     from unicore_tpu.ops.backend import kernel_backend
     from unicore_tpu.ops.pallas.flash_attention import flash_attention
 
     rng = np.random.RandomState(0)
+
+    def _note_decision(name, workload):
+        """Record which autotuner decision the AUTO dispatch used for a
+        micro ("heuristic" when nothing is cached for the bucket)."""
+        try:
+            out[name + "_tuned_config_used"] = tuning.describe_decision(
+                workload["op"], workload
+            )
+        except Exception as e:  # noqa: BLE001 - reporting must not kill micros
+            out[name + "_tuned_config_used"] = _clean(e, 120)
+
+    def _sd_wl(x, mask, bias):
+        op = lambda a: None if a is None else (a.shape, a.dtype.name)
+        return tuning.sd_workload(
+            x.shape, x.dtype.name, mask=op(mask), bias=op(bias),
+            dropout_on=True,
+        )
 
     def compare(make_fn, *args, fast="pallas"):
         """Backend speedup via the shared interleave protocol; separate
@@ -347,6 +365,7 @@ def _microbench(out):
     _micro_guard(out, "softmax_dropout_speedup", lambda: compare(
         lambda: jax.grad(sd_loss_of(x, bias)), x, bias, fast="auto"
     ))
+    _note_decision("softmax_dropout_speedup", _sd_wl(x, None, bias))
 
     # long-k rows (k=2048): the regime the reference's block kernel
     # existed for (softmax_fast.h:495-508)
@@ -355,6 +374,8 @@ def _microbench(out):
     _micro_guard(out, "softmax_dropout_k2048_kernel_speedup", lambda: compare(
         lambda: jax.grad(sd_loss_of(xk, bk)), xk, bk
     ))
+    _note_decision("softmax_dropout_k2048_kernel_speedup",
+                   _sd_wl(xk, None, bk))
 
     # 5-D Evoformer broadcast shape (mask [B,G,1,1,K], bias [1,1,H,Q,K] —
     # reference tests/test_softmax.py:81-119 contract)
@@ -370,6 +391,39 @@ def _microbench(out):
     _micro_guard(out, "softmax_dropout_evoformer_speedup", lambda: compare(
         lambda: jax.grad(sd_loss_of(xe, be, mask=me)), xe, be, fast="auto"
     ))
+    evo_wl = _sd_wl(xe, me, be)
+    _note_decision("softmax_dropout_evoformer_speedup", evo_wl)
+
+    # the crossover win, made visible (ISSUE 2): tune the evoformer
+    # bucket ON DEVICE (a warm cache reuses the entry — zero re-timings)
+    # and re-measure the auto dispatch, which now follows the measured
+    # verdict — "eager" turns the 0.985x silent regression into a >= 1.0
+    # tie by skipping the kernel; a winning q_blk config beats both
+    def _tuned_evoformer():
+        import os
+        import tempfile
+
+        from unicore_tpu.ops.tuning import TuneCache
+        from unicore_tpu.ops.tuning.tuner import tune_workloads
+
+        # tune into a SCRATCH cache and dispatch from it for this micro
+        # only: writing the persistent overlay would make the next bench
+        # run's "untuned" auto micro read this verdict, collapsing the
+        # heuristic-vs-tuned distinction the metric pair exists to show
+        scratch = TuneCache(paths=[os.path.join(
+            tempfile.mkdtemp(prefix="bench_tune_"), "cache.json"
+        )])
+        tune_workloads([evo_wl], scratch)
+        with tuning.use_cache(scratch):
+            _note_decision("softmax_dropout_evoformer_tuned_speedup",
+                           evo_wl)
+            return compare(
+                lambda: jax.grad(sd_loss_of(xe, be, mask=me)), xe, be,
+                fast="auto",
+            )
+
+    _micro_guard(out, "softmax_dropout_evoformer_tuned_speedup",
+                 _tuned_evoformer)
 
     # LayerNorm has NO kernel micro anymore: the Pallas kernel was
     # deleted in r5 after the honest re-measurement (real-bytes sync)
@@ -399,6 +453,9 @@ def _microbench(out):
         return round(r, 3), s
 
     _micro_guard(out, "flash_attention_t2048_speedup", _flash_ratio)
+    _note_decision("flash_attention_t2048_speedup", tuning.flash_workload(
+        q.shape, q.shape[1], q.dtype.name,
+    ))
 
     # fused vs eager AdamW (BASELINE.md "fused-vs-eager speedup"): the
     # framework's one-jit whole-tree update (the analogue of the

@@ -9,7 +9,17 @@ must override the jax config before any backend is initialized.  conftest
 import time is early enough (pytest imports conftest before test modules).
 """
 
+import atexit
 import os
+import shutil
+import tempfile
+
+# hermetic kernel-autotune overlay: a developer machine's tune entries
+# (~/.cache or an exported UNICORE_TPU_CACHE_DIR) must not steer
+# dispatch (block choices) inside the suite — unconditional override
+_tune_dir = tempfile.mkdtemp(prefix="unicore_tune_test_")
+os.environ["UNICORE_TPU_CACHE_DIR"] = _tune_dir
+atexit.register(shutil.rmtree, _tune_dir, ignore_errors=True)
 
 if os.environ.get("UNICORE_TPU_TEST_ON_TPU", "") != "1":
     flags = os.environ.get("XLA_FLAGS", "")

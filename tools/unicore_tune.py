@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Thin launcher for the kernel autotuner.
+
+Equivalent to ``python -m unicore_tpu.ops.tuning``; exists so the tool is
+discoverable next to the other repo tools and runnable from a checkout
+without installing the package.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from unicore_tpu.ops.tuning.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

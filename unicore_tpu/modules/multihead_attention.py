@@ -69,6 +69,21 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
     ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
     if not fa.eligible(qs, ks, None if bias is None else bias.shape):
         return False
+    # autotuner eager-crossover: a cache entry that says the measured
+    # winner for this bucket is the einsum composition routes around the
+    # kernel entirely (a forced "pallas" backend still takes flash — the
+    # parity/test override stays deterministic)
+    from unicore_tpu.ops import tuning
+    from unicore_tpu.ops.backend import get_kernel_backend
+
+    tune_dec = tuning.flash_decision(
+        q.shape, k.shape[1], q.dtype.name,
+        bias=None if bias is None else (bias.shape, bias.dtype.name),
+        has_pad=has_pad, causal=causal, dropout_on=dropout_on,
+        allow_tune=True,  # this workload carries the real batch/heads
+    )
+    if tune_dec == "eager" and get_kernel_backend() != "pallas":
+        return False
     # measured on v5e (BERT-base, T=512, trainable [1,H,T,T] bias,
     # dropout): in the SINGLE-BLOCK regime the fused backward computes
     # dq/dk/dv/dbias in one pass; isolated it is 1.6x faster than the
@@ -82,14 +97,23 @@ def _flash_ok(q, k, bias, has_pad, dropout_on, causal=False):
     # still pays a separate dbias recompute sweep, which loses below
     # T=1024; flash wins again once [B,H,Tq,Tk] is HBM-prohibitive.  A
     # forced "pallas" backend always takes flash.
-    from unicore_tpu.ops.backend import get_kernel_backend
-
     if get_kernel_backend() != "pallas" and bias is not None:
         bq, bk = fa.picked_blocks(
-            q.shape[1], k.shape[1], bias.shape, bias.dtype
+            q.shape[1], k.shape[1], bias.shape, bias.dtype,
+            dtype=q.dtype, d=q.shape[3], has_pad=has_pad, causal=causal,
+            dropout_on=dropout_on,
         )
         single_block = q.shape[1] == bq and k.shape[1] == bk
-        if not single_block and k.shape[1] < 1024:
+        # a tuned block pair is a measured verdict that flash wins at
+        # those blocks — the static multi-block/short-k crossover rule
+        # below only applies when the heuristic picked the blocks; the
+        # verdict must VALIDATE for the actual lengths (a pow2 bucket can
+        # cover lengths its blocks don't divide, in which case the blocks
+        # in use are heuristic ones the cache never vouched for)
+        tuned_applies = tuning.tuned_flash_blocks(
+            q.shape[1], k.shape[1], tune_dec
+        ) is not None
+        if not single_block and k.shape[1] < 1024 and not tuned_applies:
             return False
     # fail-open: compile-probe THIS config once per process (dtype/seq
     # lens/bias kind change the BlockSpecs); if it doesn't lower on this

@@ -67,6 +67,20 @@ def group_flash_attention(q, k, v, pair_bias, mask, dropout, deterministic,
     if not fa.eligible(qs, qs, None if bias is None else bias.shape):
         return None
     dropout_on = (not deterministic) and dropout > 0.0
+    # autotuner eager-crossover: a measured verdict that the einsum
+    # composition wins this bucket routes around the kernel (forced
+    # "pallas" backend stays on the kernel); the (B*G, T, H, D) workload
+    # carries the real grouped-batch extent, so tune mode may time it
+    from unicore_tpu.ops import tuning
+
+    tune_dec = tuning.flash_decision(
+        (B * G, T, H, D), T, q.dtype.name,
+        bias=None if bias is None else (bias.shape, bias.dtype.name),
+        has_pad=mask is not None, causal=False, dropout_on=dropout_on,
+        allow_tune=True,
+    )
+    if tune_dec == "eager" and get_kernel_backend() != "pallas":
+        return None
     if not fa.probe_ok(q.dtype, T, T, D,
                        None if bias is None else bias.shape[2],
                        None if bias is None else bias.dtype,

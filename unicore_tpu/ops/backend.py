@@ -77,6 +77,22 @@ def tpu_compiler_params(**kwargs):
     return cls(**kwargs)
 
 
+def force_result(out):
+    """Block until ``out`` is REALLY computed, by fetching a few actual
+    bytes of it.  Not ``block_until_ready``: the axon relay acks
+    readiness before compute completes, which turns timing windows into
+    phantom ~0.02ms readings (the r5 LayerNorm lesson).  Shared by
+    ``kernel_timed_winner`` and the autotuner harness (ops/tuning) —
+    every on-device timing in this codebase goes through one barrier."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if hasattr(leaf, "ndim") and leaf.ndim:
+        leaf = leaf.reshape(-1)[:1]
+    np.asarray(jax.device_get(leaf))
+
+
 _TIMED_CACHE = {}
 
 
@@ -120,23 +136,13 @@ def kernel_timed_winner(key, make_pallas, make_reference, margin=0.97,
         _TIMED_CACHE[key] = win
         return win
     try:
-        import numpy as np
-
         # dispatch sites run INSIDE the caller's jit trace (omnistaging
         # stages even constant-input ops as tracers), so the probes must
         # escape to an eval context — otherwise the "timing windows" time
         # TRACING, not the device, and the verdict is noise
         with _eval_context():
             fp, fr = make_pallas(), make_reference()
-
-            def force(out):
-                # a real-bytes fetch, NOT block_until_ready: the axon
-                # relay acks readiness before compute completes, which
-                # turned these windows into phantom ~0.02ms timings
-                leaf = jax.tree_util.tree_leaves(out)[0]
-                if hasattr(leaf, "ndim") and leaf.ndim:
-                    leaf = leaf.reshape(-1)[:1]
-                np.asarray(jax.device_get(leaf))
+            force = force_result
 
             def window(fn, iters):
                 t0 = time.perf_counter()

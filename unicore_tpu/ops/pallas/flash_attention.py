@@ -583,9 +583,20 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
 
     dtype = jnp.dtype(dtype)
     bias_dtype = None if bias_q is None else jnp.dtype(bias_dtype)
-    bq_, bk_ = _pick_blocks(
+    # the block pair the production call will ACTUALLY lower — tuner
+    # decisions included (picked_blocks consults the autotune cache and
+    # memoizes per process), and threaded into the probe key below: a
+    # probe verdict for heuristic blocks must not vouch for tuned blocks
+    # recorded under a different cache state
+    bq_, bk_ = picked_blocks(
         tq, tk,
-        0 if (bias_q is None or bias_q == 1) else jnp.dtype(bias_dtype).itemsize,
+        None if bias_q is None else (
+            1, 1 if (bias_heads is None or bias_heads == 1) else 2,
+            bias_q, tk,
+        ),
+        bias_dtype,
+        dtype=dtype, d=d, has_pad=has_pad, causal=causal,
+        dropout_on=dropout_on,
     )
     heads = heads if (tq == bq_ and tk == bk_) else 1  # hb only single-block
     if bias_q is None:
@@ -597,7 +608,7 @@ def probe_ok(dtype, tq, tk, d, bias_q, bias_dtype, has_pad, causal,
         bias_heads = 1 if (bias_heads is None or bias_heads == 1) else heads
     key = ("flash", dtype.name, tq, tk, d, bias_q,
            None if bias_dtype is None else bias_dtype.name,
-           has_pad, causal, dropout_on, heads, bias_heads)
+           has_pad, causal, dropout_on, heads, bias_heads, bq_, bk_)
 
     def build():
         q = jnp.zeros((1, tq, heads, d), dtype)
@@ -683,36 +694,61 @@ def _lse_spec(block_q):
 _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def picked_blocks(tq, tk, bias_shape=None, bias_dtype=None):
+def picked_blocks(tq, tk, bias_shape=None, bias_dtype=None, *, dtype=None,
+                  d=None, has_pad=False, causal=False, dropout_on=False):
     """The (block_q, block_k) the kernel will use for these shapes —
     THE block-choice authority, shared by `_common` and the module-level
     dispatch gate (`_flash_ok` predicts the single-block regime with it;
-    a drifted duplicate would silently misroute dispatch).  A bQ==1
-    broadcast bias streams only (1, block_k) per step (~KBs) — shrinking
-    the score block for it would multiply grid steps for no VMEM relief;
-    only a full (block_q, block_k) bias stream costs budget."""
+    a drifted duplicate would silently misroute dispatch).  When the
+    caller supplies ``dtype``/``d`` (the full variant), a tuned block
+    pair from the autotuner cache takes precedence over the heuristic —
+    validated against the ACTUAL lengths, since a pow2 shape bucket can
+    cover lengths its blocks don't divide; tuner decisions are memoized
+    per process, so the forward and backward of one custom_vjp always
+    agree.  A bQ==1 broadcast bias streams only (1, block_k) per step
+    (~KBs) — shrinking the score block for it would multiply grid steps
+    for no VMEM relief; only a full (block_q, block_k) bias stream costs
+    budget."""
     bias_itemsize = (
         jnp.dtype(bias_dtype).itemsize
         if bias_shape is not None and bias_shape[2] != 1
         else 0
     )
+    if dtype is not None and d is not None:
+        from unicore_tpu.ops import tuning
+
+        dec = tuning.flash_decision(
+            (1, tq, 1, d), tk, jnp.dtype(dtype).name,
+            bias=None if bias_shape is None else (
+                bias_shape, jnp.dtype(bias_dtype).name
+            ),
+            has_pad=has_pad, causal=causal, dropout_on=dropout_on,
+        )
+        tuned = tuning.tuned_flash_blocks(tq, tk, dec)
+        if tuned is not None:
+            return tuned
     return _pick_blocks(tq, tk, bias_itemsize)
 
 
-def _common(q, k, causal, bias=None):
+def _common(q, k, causal, bias=None, has_pad=False, dropout_on=False):
     bsz, heads, tq, d = q.shape
     tk = k.shape[2]
     block_q, block_k = picked_blocks(
         tq, tk,
         None if bias is None else bias.shape,
         None if bias is None else bias.dtype,
+        dtype=q.dtype, d=d, has_pad=has_pad, causal=causal,
+        dropout_on=dropout_on,
     )
     grid = (bsz, heads, tq // block_q, tk // block_k)
     return bsz, heads, tq, tk, d, block_q, block_k, grid
 
 
 def _flash_fwd_impl(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
-    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal, bias)
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(
+        q, k, causal, bias, has_pad=pad is not None,
+        dropout_on=dropout_prob > 0.0,
+    )
     if grid[2] == 1 and grid[3] == 1:
         return _flash_fwd_hb(
             q, k, v, bias, pad, dropout_prob, seed, causal, scale,
@@ -822,7 +858,10 @@ def _flash_fwd(q, k, v, bias, pad, dropout_prob, seed, causal, scale):
 
 def _flash_bwd(dropout_prob, causal, scale, residuals, g):
     q, k, v, bias, pad, seed, out, lse = residuals
-    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(q, k, causal, bias)
+    bsz, heads, tq, tk, d, block_q, block_k, grid = _common(
+        q, k, causal, bias, has_pad=pad is not None,
+        dropout_on=dropout_prob > 0.0,
+    )
     n_q, n_k = grid[2], grid[3]
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True

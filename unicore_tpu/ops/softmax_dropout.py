@@ -66,19 +66,49 @@ def softmax_dropout(
     return_softmax=False,
 ):
     """Fused softmax+dropout; dispatches to the Pallas kernel on TPU when the
-    shape is eligible, else the jnp reference (which XLA fuses well anyway)."""
+    shape is eligible, else the jnp reference (which XLA fuses well anyway).
+
+    Dispatch order under the auto backend: the autotuner cache first (a
+    recorded ``"eager"`` skips the kernel — the measured-crossover case;
+    a recorded ``{"q_blk": n}`` lowers that row block), then the static
+    rows-per-program crossover gate, then the per-shape timed probe.  A
+    forced ``"pallas"`` backend always takes the kernel (with a tuned
+    row block when one is cached) — the parity/test override stays
+    deterministic."""
     if use_pallas() and not return_softmax and _pallas_eligible(x, mask, bias):
+        from . import tuning
         from .backend import get_kernel_backend
         from .pallas import softmax_dropout as pl_impl
 
         dropout_on = is_training and float(dropout_prob) > 0.0
-        if _probe_ok(x, mask, bias, dropout_on) and (
-            get_kernel_backend() == "pallas"
-            or _timed_win(x, mask, bias, dropout_on)
-        ):
+        forced = get_kernel_backend() == "pallas"
+        opinfo = lambda op: (
+            None if op is None else (op.shape, op.dtype.name)
+        )
+        dec = tuning.softmax_dropout_decision(
+            x.shape, x.dtype.name, mask=opinfo(mask), bias=opinfo(bias),
+            dropout_on=dropout_on, allow_tune=True,
+        )
+        q_blk = tuning.tuned_q_blk(x.shape[-2], dec)
+        if forced or q_blk is not None:
+            # forced backend, or an APPLICABLE measured verdict: probe
+            # only.  A config whose q_blk doesn't validate for this row
+            # count (pow2 buckets cover rows their block doesn't divide)
+            # was never measured as-lowered — fall through to the
+            # heuristic + timed path instead of trusting it.
+            take_kernel = _probe_ok(x, mask, bias, dropout_on, q_blk)
+        elif dec == "eager":
+            take_kernel = False
+        else:
+            take_kernel = (
+                _heuristic_kernel_win(x, mask, bias)
+                and _probe_ok(x, mask, bias, dropout_on, q_blk)
+                and _timed_win(x, mask, bias, dropout_on)
+            )
+        if take_kernel:
             return pl_impl.softmax_dropout(
                 x, dropout_prob, rng=rng, is_training=is_training,
-                mask=mask, bias=bias,
+                mask=mask, bias=bias, q_blk=q_blk,
             )
     return softmax_dropout_reference(
         x,
@@ -91,12 +121,32 @@ def softmax_dropout(
     )
 
 
-def _probe_ok(x, mask, bias, dropout_on):
+def _heuristic_kernel_win(x, mask, bias):
+    """Static crossover gate for the out-of-the-box (no-cache) path: the
+    kernel pays ~2us of fixed cost per grid program plus its streaming
+    setup, so when each program's row block is small the eager XLA
+    fusion wins and the kernel must NOT lower.  The gate is elements per
+    program (row_block x k): the BENCH_r05 evoformer shape (5-D batched
+    mask/bias, 128x128 blocks, 512 programs, 16K elements each) measured
+    0.985-0.994x eager — a silent regression — while the BERT and k=2048
+    shapes sit at 131K elements per program and win (1.13x / 1.11x).
+    The 64K threshold leaves 2x margin to both sides; the autotuner's
+    measured per-bucket verdict overrides this gate in either
+    direction."""
+    from .pallas.softmax_dropout import _pick_q_blk_for
+
+    return _pick_q_blk_for(x, mask, bias) * x.shape[-1] >= (1 << 16)
+
+
+def _probe_ok(x, mask, bias, dropout_on, q_blk=None):
     """FAIL-OPEN compile probe keyed on everything affecting Mosaic
-    lowering: dtype, rank, (q, k) tail shape, and the mask/bias broadcast
-    patterns (which dims are 1).  The probe shrinks lead dims to 1 —
-    block shapes there are 1 either way, only grid size changes — so a
-    config that lowers for the probe lowers for the real call."""
+    lowering: dtype, rank, (q, k) tail shape, the mask/bias broadcast
+    patterns (which dims are 1), and the row block the call will lower —
+    a tuned ``q_blk`` changes the BlockSpecs, so it is probed exactly as
+    production lowers it (no stale verdicts when the tune cache changes
+    between runs).  The probe shrinks lead dims to 1 — block shapes
+    there are 1 either way, only grid size changes — so a config that
+    lowers for the probe lowers for the real call."""
     from .backend import kernel_probe_ok
 
     q, k = (x.shape[-2], x.shape[-1]) if x.ndim >= 2 else (1, x.shape[-1])
@@ -105,7 +155,7 @@ def _probe_ok(x, mask, bias, dropout_on):
         else (op.dtype.name, tuple(s == 1 for s in op.shape))
     )
     key = ("softmax_dropout", x.dtype.name, x.ndim, q, k,
-           pat(mask), pat(bias), dropout_on)
+           pat(mask), pat(bias), dropout_on, q_blk)
 
     def build():
         from .pallas import softmax_dropout as pl_impl
@@ -131,7 +181,7 @@ def _probe_ok(x, mask, bias, dropout_on):
             return jnp.sum(
                 pl_impl.softmax_dropout(
                     px, dp, rng=prng, is_training=dropout_on,
-                    mask=pm, bias=pb,
+                    mask=pm, bias=pb, q_blk=q_blk,
                 ).astype(jnp.float32)
             )
 

@@ -1,0 +1,194 @@
+"""Persistent kernel-tune cache: repo file + user overlay.
+
+Two layers, merged at load (overlay wins):
+
+- ``tools/kernel_tune_cache.json`` in the checkout — the committed,
+  reviewed cache a pod slice ships with (pre-populated via
+  ``unicore_tune tune`` on one chip of the target kind);
+- ``~/.cache/unicore_tpu/kernel_tune_cache.json`` (or
+  ``$UNICORE_TPU_CACHE_DIR``) — per-machine results from local ``tune``
+  runs, written atomically.
+
+Entries are grouped under an ENVIRONMENT FINGERPRINT (device kind + jax
+version + libtpu version + cache format): an entry tuned on a v5e under
+one jax release simply does not exist for a v4 or after an upgrade, so
+stale configs self-invalidate to the heuristic path instead of lowering
+blocks a different Mosaic might reject.  Nothing here ever raises into
+dispatch: a corrupt or unreadable file reads as an empty cache.
+"""
+
+import json
+import logging
+import os
+import tempfile
+
+logger = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+
+
+def _device_kind():
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind.replace("|", "/")
+    except Exception:  # pragma: no cover - backend init failure
+        return "unknown"
+
+
+def _libtpu_version():
+    try:
+        from importlib import metadata
+
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                return metadata.version(dist)
+            except metadata.PackageNotFoundError:
+                continue
+    except Exception:  # pragma: no cover
+        pass
+    return "none"
+
+
+def env_fingerprint():
+    """The key namespace all entries live under — everything that can
+    change which config compiles or wins."""
+    import jax
+
+    return "|".join((
+        f"fmt{FORMAT_VERSION}",
+        _device_kind(),
+        f"jax{jax.__version__}",
+        f"libtpu{_libtpu_version()}",
+    ))
+
+
+def bucket_key(parts):
+    """Serialize a bucket tuple into the stable string JSON entries key
+    on.  Parts are primitives (str/int/bool/None) by construction."""
+    return "|".join("~" if p is None else str(p) for p in parts)
+
+
+def repo_cache_path():
+    """``tools/kernel_tune_cache.json`` of the checkout this package was
+    imported from (missing for wheel installs — reads as empty)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(os.path.dirname(pkg), "tools",
+                        "kernel_tune_cache.json")
+
+
+def overlay_cache_path():
+    base = os.environ.get("UNICORE_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "unicore_tpu"
+    )
+    return os.path.join(base, "kernel_tune_cache.json")
+
+
+def _read_file(path):
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("format") != FORMAT_VERSION:
+            return {}
+        entries = data.get("entries")
+        return entries if isinstance(entries, dict) else {}
+    except FileNotFoundError:
+        return {}
+    except Exception as e:  # noqa: BLE001 - corrupt cache reads as empty
+        logger.warning("kernel tune cache %s unreadable (%s); ignoring",
+                       path, e)
+        return {}
+
+
+class TuneCache:
+    """Merged repo+overlay view for one environment fingerprint.
+
+    ``lookup``/``record`` speak decisions: the string ``"eager"`` or a
+    flat config dict (e.g. ``{"block_q": 512, "block_k": 2048}``).
+    """
+
+    def __init__(self, paths=None, fingerprint=None):
+        if paths is None:
+            paths = [repo_cache_path(), overlay_cache_path()]
+        self.paths = list(paths)
+        self.write_path = self.paths[-1]
+        self.fingerprint = fingerprint or env_fingerprint()
+        self._merged = None
+
+    def _load(self):
+        if self._merged is None:
+            merged = {}
+            for p in self.paths:
+                for fp, entries in _read_file(p).items():
+                    merged.setdefault(fp, {}).update(entries)
+            self._merged = merged
+        return self._merged
+
+    def reload(self):
+        self._merged = None
+
+    def entries(self):
+        """All entries for the CURRENT environment fingerprint."""
+        return dict(self._load().get(self.fingerprint, {}))
+
+    def all_entries(self):
+        """{fingerprint: {key: entry}} across every environment (report
+        use; dispatch only ever reads the current fingerprint)."""
+        return {fp: dict(es) for fp, es in self._load().items()}
+
+    def get(self, key):
+        """Full entry dict for ``key`` (timings and all), or None."""
+        return self._load().get(self.fingerprint, {}).get(key)
+
+    def lookup(self, key):
+        """The recorded decision for ``key``: ``"eager"``, a config
+        dict, or None on miss.  Entries from dry runs (fake timings —
+        the CI plumbing check) are NEVER decisions: they read as misses
+        here, while :meth:`get` still sees them so a dry-run rerun can
+        report reuse."""
+        entry = self.get(key)
+        if not isinstance(entry, dict) or entry.get("source") == "dry":
+            return None
+        winner = entry.get("winner")
+        if winner == "eager" or isinstance(winner, dict):
+            return winner
+        return None
+
+    def record(self, key, winner, micros_us=None, source="timed"):
+        """Record a winner and persist to the overlay file (atomic
+        write; failures log and keep the in-memory entry)."""
+        entry = {"winner": winner, "source": source}
+        if micros_us:
+            entry["micros_us"] = {
+                k: round(float(v), 2) for k, v in micros_us.items()
+            }
+        self._load().setdefault(self.fingerprint, {})[key] = entry
+        self._persist()
+        return entry
+
+    def _persist(self):
+        # the overlay file holds ONLY what this cache instance wrote on
+        # top of whatever that file already had (never the repo layer:
+        # round-tripping it into the overlay would mask later repo edits)
+        try:
+            on_disk = _read_file(self.write_path)
+            for fp, entries in self._load().items():
+                base = {}
+                for p in self.paths[:-1]:
+                    base.update(_read_file(p).get(fp, {}))
+                for k, v in entries.items():
+                    if base.get(k) != v:
+                        on_disk.setdefault(fp, {})[k] = v
+            payload = {"format": FORMAT_VERSION, "entries": on_disk}
+            d = os.path.dirname(self.write_path) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.write_path)
+        except Exception as e:  # noqa: BLE001 - cache write is best-effort
+            logger.warning("could not persist kernel tune cache to %s: %s",
+                           self.write_path, e)

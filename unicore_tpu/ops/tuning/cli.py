@@ -1,0 +1,182 @@
+"""``unicore_tune`` — the kernel-autotuner CLI.
+
+    python -m unicore_tpu.ops.tuning tune  [--workloads a,b] [--force]
+    python -m unicore_tpu.ops.tuning tune  --dry-run   # CI plumbing check
+    python -m unicore_tpu.ops.tuning cache              # report the cache
+    python -m unicore_tpu.ops.tuning off                # how to disable
+
+``tune`` times every preset workload on the attached device and records
+winners; re-running against a warm cache reports ``timed: 0`` (zero
+re-timings) unless ``--force``.  ``--dry-run`` swaps the device timer
+for deterministic fake timings and shrinks workloads to lead-dim 1, so
+the full pipeline — candidate enumeration, forced-config tracing,
+interpret-mode lowering, cache round-trip — runs on CPU in seconds.
+
+Pre-populating a new pod slice: run ``unicore_tune tune`` on ONE chip of
+the target kind, then commit the resulting entries into
+``tools/kernel_tune_cache.json`` (see docs/kernel_autotuning.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="unicore_tune",
+        description="kernel autotuner: measured Pallas config selection "
+                    "with eager-crossover",
+    )
+    p.add_argument("mode", nargs="?", default="tune",
+                   choices=["tune", "cache", "off"],
+                   help="tune: benchmark + record; cache: report the "
+                        "cache; off: print how to disable autotuning")
+    p.add_argument("--workloads", default=None, metavar="A,B,...",
+                   help="comma-separated preset names (default: all); "
+                        "see --list")
+    p.add_argument("--list", action="store_true",
+                   help="list preset workloads and exit")
+    p.add_argument("--cache", default=None, metavar="FILE",
+                   help="cache file to read AND write (default: repo "
+                        "cache + ~/.cache/unicore_tpu overlay)")
+    p.add_argument("--force", action="store_true",
+                   help="re-time buckets that already have cache entries")
+    p.add_argument("--dry-run", action="store_true",
+                   help="no device timing: shrink workloads, lower each "
+                        "candidate in interpret mode, use deterministic "
+                        "fake timings (validates plumbing on CPU)")
+    p.add_argument("--allow-non-tpu", action="store_true",
+                   help="permit real timing on a non-TPU backend "
+                        "(timings then describe XLA:CPU, not the chip)")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="also write the report as JSON")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def _select_workloads(names_csv):
+    from unicore_tpu.ops.tuning import PRESETS
+
+    if not names_csv:
+        return dict(PRESETS)
+    out = {}
+    for name in names_csv.split(","):
+        name = name.strip()
+        if name not in PRESETS:
+            raise SystemExit(
+                f"unknown workload {name!r}; presets: "
+                f"{', '.join(sorted(PRESETS))}"
+            )
+        out[name] = PRESETS[name]
+    return out
+
+
+def _print_report(report, log):
+    from unicore_tpu.ops.tuning import describe_config
+
+    for key, entry in sorted(report["entries"].items()):
+        winner = entry.get("winner")
+        desc = describe_config(winner) if winner else "?"
+        if entry.get("source") == "dry":
+            desc += "  [dry: fake timings, never served to dispatch]"
+        micros = entry.get("micros_us") or {}
+        timing = ", ".join(
+            f"{n}={t:.1f}us" for n, t in sorted(micros.items())
+        )
+        log(f"  [{entry.get('status', 'cached')}] {key}")
+        log(f"      winner: {desc}" + (f"  ({timing})" if timing else ""))
+    log(f"buckets: {len(report['entries'])}  timed: {report['timed']}  "
+        f"reused: {report['reused']}" + (
+            "  (warm cache: zero re-timings)"
+            if report["entries"] and report["timed"] == 0 else ""))
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    log = (lambda *a: None) if args.quiet else (
+        lambda *a: print("unicore_tune:", *a, file=sys.stderr)
+    )
+
+    from unicore_tpu.ops import tuning
+
+    if args.list:
+        for name, wl in sorted(tuning.PRESETS.items()):
+            print(f"{name}: {wl}")
+        return 0
+
+    if args.mode == "off":
+        print("kernel autotuning off: pass --kernel-autotune off to the "
+              "trainer or set UNICORE_TPU_KERNEL_AUTOTUNE=off; dispatch "
+              "then uses the static heuristics only.")
+        return 0
+
+    tune_cache = None
+    if args.cache:
+        tune_cache = tuning.TuneCache(paths=[args.cache])
+
+    if args.mode == "cache":
+        cache = tune_cache or tuning.get_cache()
+        entries = cache.entries()
+        stale = {
+            fp: len(es) for fp, es in cache.all_entries().items()
+            if fp != cache.fingerprint
+        }
+        report = {
+            "fingerprint": cache.fingerprint,
+            "entries": {k: dict(v, status="cached")
+                        for k, v in entries.items()},
+            "timed": 0,
+            "reused": len(entries),
+            "stale_fingerprints": stale,
+        }
+        _print_report(report, log)
+        for fp, n in sorted(stale.items()):
+            log(f"  stale: {n} entr{'y' if n == 1 else 'ies'} under {fp} "
+                f"(ignored on this environment)")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+        return 0
+
+    # mode == "tune"
+    if not args.dry_run:
+        from unicore_tpu.ops.backend import _on_tpu
+
+        if not _on_tpu() and not args.allow_non_tpu:
+            log("no TPU attached: refusing to record CPU timings into the "
+                "cache (use --dry-run for a plumbing check, or "
+                "--allow-non-tpu to time XLA:CPU anyway)")
+            return 2
+
+    if args.dry_run and tune_cache is None:
+        # fake timings must never land in the real overlay, and a FIXED
+        # scratch path would let a previous run's entries turn the
+        # plumbing check into an all-"reused" no-op — default to a fresh
+        # per-invocation file (pass --cache to test warm-cache reuse)
+        path = os.path.join(
+            tempfile.mkdtemp(prefix="unicore_tune_dry_"), "cache.json"
+        )
+        log(f"dry-run without --cache: writing to {path} (dry entries "
+            f"never serve dispatch either way)")
+        tune_cache = tuning.TuneCache(paths=[path])
+
+    from unicore_tpu.ops.tuning.tuner import tune_workloads
+
+    workloads = _select_workloads(args.workloads)
+    log(f"tuning {len(workloads)} workload(s): "
+        f"{', '.join(sorted(workloads))}" + (
+            " [dry-run: fake timings, shrunk shapes]" if args.dry_run
+            else ""))
+    report = tune_workloads(
+        list(workloads.values()), tune_cache, force=args.force,
+        dry_run=args.dry_run, log=log,
+    )
+    report["workloads"] = sorted(workloads)
+    _print_report(report, log)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    return 0

@@ -1,0 +1,171 @@
+"""The timing harness: benchmark candidate configs on-device, record the
+winner, fail open everywhere.
+
+Measurement protocol (the hard-won house rules from ``bench.py`` /
+``backend.kernel_timed_winner``):
+
+- every candidate is AOT-compiled BEFORE its timing windows (compile
+  time never pollutes a window);
+- completion is a REAL-BYTES fetch of one element of the result, not
+  ``block_until_ready`` — on a relayed chip the readiness ack can land
+  before compute completes and multi-ms kernels "measure" at ~0.02ms;
+- window iteration counts are sized from a pipelined estimate so cheap
+  configs don't drown in per-dispatch jitter;
+- the recorded time is the MEDIAN of N windows (best-of drifts ±15%
+  between sessions on the relay link);
+- a kernel config must beat eager by a noise MARGIN (t < 0.97 x
+  t_eager) or the bucket records ``"eager"`` — a tie routed to the
+  kernel is downside-only.
+
+Dry-run mode (``timer=`` injected) still BUILDS every candidate — the
+trace/lower/compile path, the ``forced_config`` plumbing, and the cache
+write are all exercised — but takes its "timings" from the injected
+function, so CI validates the subsystem on CPU in interpret mode with
+deterministic picks and zero device time.
+"""
+
+import hashlib
+import logging
+import time
+
+from unicore_tpu.ops.tuning import cache as cache_mod
+from unicore_tpu.ops.tuning.candidates import OPS, describe_config
+
+logger = logging.getLogger(__name__)
+
+WIN_MARGIN = 0.97
+MEDIAN_OF = 5
+
+
+def _force(out):
+    from unicore_tpu.ops.backend import force_result
+
+    force_result(out)
+
+
+def _window(fn, iters):
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    _force(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def measure(fn, median_of=MEDIAN_OF, target_window_s=0.05):
+    """Median-of-N window time (seconds) of an already-compiled step."""
+    _force(fn())  # first dispatch (weight upload, caching)
+    est = _window(fn, 10)
+    iters = max(20, min(2000, int(target_window_s / max(est, 1e-7))))
+    ts = sorted(_window(fn, iters) for _ in range(median_of))
+    return ts[median_of // 2]
+
+
+def fake_timer(key, config):
+    """Deterministic stand-in timings for dry runs: a hash of
+    (bucket-key, config), stable across runs and machines, so the CI
+    plumbing check always picks the same winner."""
+    h = hashlib.md5(
+        f"{key}::{describe_config(config)}".encode()
+    ).hexdigest()
+    return 1e-3 + (int(h, 16) % 1000000) / 1e9
+
+
+def tune_bucket(spec, workload, tune_cache, *, force=False, timer=None,
+                margin=WIN_MARGIN, log=None):
+    """Tune one (op, bucket): benchmark every candidate, record the
+    winner.  Returns ``(status, key, entry)`` with status ``"reused"``
+    (cache hit, NOTHING timed) or ``"timed"``.
+
+    ``timer``: optional ``f(key, config) -> seconds`` replacing device
+    measurement (dry runs / tests).  Candidates that fail to build are
+    skipped (fail-open — exactly the configs Mosaic rejects); if every
+    kernel candidate fails, eager wins by walkover.
+    """
+    from unicore_tpu.ops import tuning
+    from unicore_tpu.ops.backend import _eval_context
+
+    key = cache_mod.bucket_key(spec.bucket(workload))
+    existing = tune_cache.get(key)
+    if existing is not None and not force:
+        # a REAL tune run must not count a dry (fake-timing) entry as
+        # done — those never serve dispatch, so "reusing" one would
+        # silently leave the bucket untimed; dry reruns do reuse them
+        # (that is the CI zero-re-timings check)
+        if timer is not None or existing.get("source") != "dry":
+            return "reused", key, existing
+
+    log = log or (lambda *a: None)
+    micros = {}
+    with _eval_context():
+        for config in spec.candidates(workload):
+            name = describe_config(config)
+            try:
+                with tuning.forced_config(spec.name, config):
+                    fn = spec.build_runner(workload, config)
+                    t = timer(key, config) if timer is not None else measure(fn)
+                micros[name] = t * 1e6
+                log(f"  {key} {name}: {t * 1e6:.1f}us")
+            except Exception as e:  # noqa: BLE001 - fail-open per candidate
+                logger.warning("tune %s candidate %s failed (%s); skipped",
+                               key, name, str(e)[:300])
+    winner = _pick_winner(spec, workload, micros, margin)
+    entry = tune_cache.record(
+        key, winner, micros_us=micros,
+        source="dry" if timer is not None else "timed",
+    )
+    return "timed", key, entry
+
+
+def _pick_winner(spec, workload, micros, margin):
+    kernel = {n: t for n, t in micros.items() if n != "eager"}
+    if not kernel:
+        return "eager"
+    best_name = min(kernel, key=kernel.get)
+    t_eager = micros.get("eager")
+    if t_eager is not None and not kernel[best_name] < margin * t_eager:
+        return "eager"
+    # map the winning name back to its config dict
+    for config in spec.candidates(workload):
+        if config != "eager" and describe_config(config) == best_name:
+            return config
+    return "eager"  # pragma: no cover - names derive from candidates
+
+
+def tune_workloads(workloads, tune_cache=None, *, force=False, dry_run=False,
+                   timer=None, log=None):
+    """Tune a batch of workload dicts (see ``candidates.py`` builders).
+    Returns a report: per-entry results plus ``timed``/``reused`` counts
+    — a warm cache shows ``timed == 0`` (zero re-timings).
+    """
+    from unicore_tpu.ops import tuning
+
+    if tune_cache is None:
+        tune_cache = tuning.get_cache()
+    if dry_run and timer is None:
+        timer = fake_timer
+    report = {
+        "fingerprint": tune_cache.fingerprint,
+        "cache_path": tune_cache.write_path,
+        "dry_run": bool(timer is not None),
+        "timed": 0,
+        "reused": 0,
+        "entries": {},
+    }
+    for wl in workloads:
+        spec = OPS[wl["op"]]
+        if timer is not None:
+            wl = spec.shrink(wl)
+        try:
+            status, key, entry = tune_bucket(
+                spec, wl, tune_cache, force=force, timer=timer, log=log,
+            )
+        except Exception as e:  # noqa: BLE001 - one bad workload can't
+            # take down the sweep
+            logger.warning("tuning workload %r failed: %s", wl["op"],
+                           str(e)[:300])
+            continue
+        report[status] += 1
+        report["entries"][key] = dict(entry, status=status)
+    tuning.reset_memo()  # fresh decisions see the new entries
+    return report

@@ -73,6 +73,21 @@ def _flash_local_ok(q_shape, k_shape, bias_shape, bias_dtype, has_pad,
     ks = (k_shape[0], h_local, k_shape[1], d)
     if not fa.eligible(qs, ks, bias_shape):
         return False
+    # autotuner eager-crossover on the LOCAL shapes (the per-device
+    # workload is what actually runs); forced "pallas" stays kernel
+    from unicore_tpu.ops import tuning
+    from unicore_tpu.ops.backend import get_kernel_backend
+
+    tune_dec = tuning.flash_decision(
+        (b, t, h_local, d), k_shape[1], jnp.dtype(dtype).name,
+        bias=None if bias_shape is None else (
+            bias_shape, jnp.dtype(bias_dtype).name
+        ),
+        has_pad=has_pad, causal=causal, dropout_on=dropout_on,
+        allow_tune=True,
+    )
+    if tune_dec == "eager" and get_kernel_backend() != "pallas":
+        return False
     return fa.probe_ok(
         dtype, t, k_shape[1], d,
         None if bias_shape is None else bias_shape[2],
