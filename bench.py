@@ -535,6 +535,65 @@ def _microbench(out):
     _micro_guard(out, "evoformer_block_step_ms",
                  lambda: round(_timed(g_blk, bparams) * 1e3, 2))
 
+    # serve tier (ISSUE 3): the paged-KV continuous-batching engine on
+    # chip — steady-state decode throughput and prefill TTFT at a
+    # realistic small-LM shape.  One engine instance is reused so the
+    # jitted prefill/decode executables compile once (warmup request)
+    # and the measured numbers are steady-state, like production serving.
+    def _serve_engine():
+        from examples.lm.model import TransformerLMModel
+        from unicore_tpu.serve.engine import ServeEngine
+
+        model = TransformerLMModel(
+            vocab_size=4096, padding_idx=0, decoder_layers=4,
+            decoder_embed_dim=512, decoder_ffn_embed_dim=2048,
+            decoder_attention_heads=8, max_seq_len=2048,
+            emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+            activation_dropout=0.0, rel_pos=False, abs_pos=False,
+            rotary=True,
+        )
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return model, ServeEngine(
+            model, params, num_pages=40, page_size=64, max_batch=8,
+        )
+
+    def _serve_micros():
+        from unicore_tpu.serve.scheduler import Request
+
+        srng = np.random.RandomState(0)
+        model, engine = _serve_engine()
+
+        def reqs(n, prompt_len, max_new):
+            return [Request(
+                prompt=srng.randint(
+                    1, model.vocab_size, size=(prompt_len,)).tolist(),
+                max_new_tokens=max_new, seed=i,
+            ) for i in range(n)]
+
+        # warmup: compiles the 512-bucket prefill and the decode step
+        engine.generate(reqs(2, 512, 2))
+
+        # TTFT: enqueue-to-first-token of a single 512-token prompt on
+        # the warm engine (median of 5)
+        ttfts = sorted(
+            engine.generate(reqs(1, 512, 1))[0].ttft_ms for _ in range(5)
+        )
+        out["serve_prefill_ttft_ms"] = round(ttfts[2], 2)
+
+        # decode throughput: 8 concurrent 128-token prompts, 64 new
+        # tokens each — deltas so warmup/TTFT work is excluded
+        tok0 = engine.stats["decode_tokens"]
+        time0 = engine.stats["decode_time_s"]
+        engine.generate(reqs(8, 128, 64))
+        d_tok = engine.stats["decode_tokens"] - tok0
+        d_t = engine.stats["decode_time_s"] - time0
+        out["serve_decode_batch"] = 8
+        return round(d_tok / d_t, 1)
+
+    _micro_guard(out, "serve_decode_tokens_per_sec", _serve_micros)
+
     # --fp16 evidence (VERDICT r4 weak-6): one measured fp16 train run —
     # fp16 compute + dynamic loss scaler — at the batch-32 ladder config.
     # v5e MXU lanes are bf16-native, so fp16 is expected to TRAIL bf16;

@@ -6,18 +6,34 @@ One jit-compiled step is reused for every position: the cache (flax
 "cache" collection: per-layer cached_key/cached_value/cache_index) is
 threaded functionally, positions drive RoPE/absolute embeddings, and the
 prompt prefills in a single call before single-token steps.
+
+RIGHT-padded batches are supported (since PR 3): the prefill carries 2-D
+per-sequence positions (-1 on pad rows, which park their k/v in the
+cache's trash slot), the first logits are read from each row's last
+VALID position, and every later step advances each sequence at its own
+offset — so the generated continuation of every row is token-identical
+to generating it alone.  LEFT/interior padding is still rejected: a pad
+BETWEEN real tokens has no consistent cache slot.
+
+Sampling goes through ``unicore_tpu.serve.sampling`` — the same
+greedy/temperature/top-k implementation the serve engine uses, so both
+paths emit identical tokens for identical (logits, seed).
 """
 
 import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from unicore_tpu.serve.sampling import sample_token
 
 
 def init_cache(model, batch_size, max_len):
-    """Allocate a decode cache with capacity ``max_len``: shapes come
-    from ``eval_shape`` over init (zero FLOPs — a real init would run a
-    full O(max_len^2) forward just to read back zero buffers)."""
+    """Allocate a decode cache with capacity ``max_len`` (+1 trash slot,
+    see ``SelfMultiheadAttention._decode_attend``): shapes come from
+    ``eval_shape`` over init (zero FLOPs — a real init would run a full
+    O(max_len^2) forward just to read back zero buffers)."""
     proto = jnp.zeros((batch_size, max_len), jnp.int32)
     # decode must stay a PYTHON bool (it drives trace-time control flow),
     # so close over it rather than passing it through eval_shape
@@ -40,6 +56,23 @@ def _prefill(model, params, cache, prompt):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
+def _prefill_ragged(model, params, cache, prompt, lengths):
+    """Right-padded prefill: per-sequence positions (-1 on pad rows) and
+    last-valid-row logits."""
+    t0 = prompt.shape[1]
+    rows = jnp.arange(t0, dtype=jnp.int32)[None, :]
+    positions = jnp.where(rows < lengths[:, None], rows, -1)
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        positions=positions, mutable=["cache"],
+    )
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    return last, mutated["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
 def _step(model, params, cache, token, t):
     logits, mutated = model.apply(
         {"params": params, "cache": cache}, token[:, None], decode=True,
@@ -48,46 +81,88 @@ def _step(model, params, cache, token, t):
     return logits[:, -1], mutated["cache"]
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _step_ragged(model, params, cache, token, t):
+    """``t`` [B]: each sequence's own global position this step."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, token[:, None], decode=True,
+        positions=t[:, None], mutable=["cache"],
+    )
+    return logits[:, -1], mutated["cache"]
+
+
+def _prompt_lengths(prompt, padding_idx):
+    """Valid-prefix lengths of a right-padded batch; raises on interior/
+    left padding or empty rows (no consistent cache layout exists)."""
+    valid = np.asarray(prompt) != padding_idx
+    lengths = valid.sum(axis=1)
+    right_padded = (valid.cumsum(axis=1) == np.minimum(
+        np.arange(1, valid.shape[1] + 1)[None, :], lengths[:, None]
+    )).all()
+    if not right_padded or (lengths == 0).any():
+        raise ValueError(
+            "generate: prompts must be unpadded or RIGHT-padded "
+            "(padding between or before real tokens has no consistent "
+            "cache slot, and an all-padding row has nothing to continue)"
+        )
+    return lengths
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, max_len=None):
+             rng=None, max_len=None, top_k=0):
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T0].
 
-    ``temperature`` 0 = greedy; otherwise softmax sampling (requires
-    ``rng``).  Returns int32 [B, T0 + max_new_tokens]."""
+    ``temperature`` 0 = greedy; otherwise seeded softmax sampling with
+    optional ``top_k`` (requires ``rng``) — via the serve tier's shared
+    sampling helper, so the same seed yields the same tokens here and in
+    ``ServeEngine``.  Right-padded prompts are continued from each row's
+    own last valid token, the generated tokens overwriting the padding;
+    returns int32 [B, T0 + max_new_tokens] (rows of a ragged batch keep
+    trailing padding after their ``max_new_tokens`` tokens)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     bsz, t0 = prompt.shape
     capacity = max_len or model.max_seq_len
-    assert t0 + max_new_tokens <= capacity, (
-        f"prompt ({t0}) + new tokens ({max_new_tokens}) exceeds cache "
-        f"capacity ({capacity})"
+    lengths = _prompt_lengths(prompt, model.padding_idx)
+    assert int(lengths.max()) + max_new_tokens <= capacity, (
+        f"prompt ({int(lengths.max())}) + new tokens ({max_new_tokens}) "
+        f"exceeds cache capacity ({capacity})"
     )
-    if bool((prompt == model.padding_idx).any()):
-        raise ValueError(
-            "generate: prompts must not contain padding tokens (pad k/v "
-            "would enter the cache and be attended by every later step); "
-            "generate ragged batches prompt-by-prompt"
-        )
-    cache = init_cache(model, bsz, capacity)
-    logit, cache = _prefill(model, params, cache, prompt)
-
-    def pick(logit, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logit, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logit.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
-
     if temperature > 0.0 and rng is None:
         raise ValueError("generate: rng required when temperature > 0")
-    out = [prompt]
+    ragged = bool((lengths < t0).any())
+    cache = init_cache(model, bsz, capacity)
+    if ragged:
+        len_dev = jnp.asarray(lengths, jnp.int32)
+        logit, cache = _prefill_ragged(model, params, cache, prompt,
+                                       len_dev)
+    else:
+        logit, cache = _prefill(model, params, cache, prompt)
+
+    def pick(logit, key):
+        return sample_token(logit, key=key, temperature=temperature,
+                            top_k=top_k)
+
+    out = np.asarray(prompt)
+    out = np.concatenate(
+        [out, np.full((bsz, max_new_tokens), model.padding_idx, out.dtype)],
+        axis=1,
+    )
+    rows = np.arange(bsz)
     for i in range(max_new_tokens):
         key = None
         if temperature > 0.0:
             rng, key = jax.random.split(rng)
         tok = pick(logit, key)
-        out.append(tok[:, None])
+        out[rows, lengths + i] = np.asarray(tok)
         if i + 1 < max_new_tokens:
-            logit, cache = _step(
-                model, params, cache, tok, jnp.asarray(t0 + i, jnp.int32)
-            )
-    return jnp.concatenate(out, axis=1)
+            if ragged:
+                logit, cache = _step_ragged(
+                    model, params, cache, tok,
+                    jnp.asarray(lengths + i, jnp.int32),
+                )
+            else:
+                logit, cache = _step(
+                    model, params, cache, tok,
+                    jnp.asarray(t0 + i, jnp.int32),
+                )
+    return jnp.asarray(out)

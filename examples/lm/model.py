@@ -145,9 +145,11 @@ class TransformerLMModel(BaseUnicoreModel):
 
     @nn.compact
     def __call__(self, src_tokens, deterministic=True, decode=False,
-                 positions=None, **kwargs):
-        # decoding assumes unpadded prompts (generate() enforces); the
-        # decoder drops the key-padding mask on the decode path itself
+                 positions=None, paged=None, **kwargs):
+        # decoding assumes unpadded OR right-padded prompts (generate()
+        # enforces; a 2-D positions array carries the per-sequence
+        # offsets); the decoder drops the key-padding mask on the decode
+        # path itself
         padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
         embed = nn.Embed(
             self.vocab_size,
@@ -164,7 +166,11 @@ class TransformerLMModel(BaseUnicoreModel):
             if positions is None:
                 x = x + pos[: src_tokens.shape[1], :].astype(x.dtype)
             else:
-                x = x + jnp.take(pos, positions, axis=0).astype(x.dtype)
+                # -1 marks inactive (padded) rows; clamp keeps the gather
+                # in-bounds — those rows are masked out of attention
+                x = x + jnp.take(
+                    pos, jnp.maximum(positions, 0), axis=0
+                ).astype(x.dtype)
 
         x = TransformerDecoder(
             decoder_layers=self.decoder_layers,
@@ -184,7 +190,7 @@ class TransformerLMModel(BaseUnicoreModel):
             auto_regressive=True,
             name="decoder",
         )(x, padding_mask=padding_mask, deterministic=deterministic,
-          decode=decode, positions=positions)
+          decode=decode, positions=positions, paged=paged)
 
         # tied projection + final LN'd features -> logits
         x = LayerNorm(self.decoder_embed_dim, name="out_layer_norm")(x)

@@ -48,6 +48,7 @@ setup(
     entry_points={
         "console_scripts": [
             "unicore-train = unicore_tpu_cli.train:cli_main",
+            "unicore-serve = unicore_tpu.serve.cli:main",
         ],
     },
 )

@@ -111,14 +111,67 @@ def test_decode_with_rel_pos_fails_fast(rng):
         model.init(jax.random.PRNGKey(0), toks, decode=True)
 
 
-def test_generate_rejects_padded_prompts(rng):
+@pytest.mark.parametrize("variant", ["abs_pos", "rotary"])
+def test_generate_right_padded_prompts_match_solo(rng, variant):
+    """Right-padded ragged batches generate: every row's continuation is
+    token-identical to generating that row alone (the per-sequence
+    positions/first-decode-offset path), and the generated tokens
+    overwrite the padding."""
+    from examples.lm.generate import generate
+
+    model = make_model(abs_pos=variant == "abs_pos",
+                       rotary=variant == "rotary")
+    lens = [3, 6, 4]
+    t0, n_new = max(lens), 5
+    prompts = [rng.randint(1, V, size=(n,)).astype(np.int32)
+               for n in lens]
+    batch = np.full((len(lens), t0), PAD, np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, : len(p)] = p
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(batch))["params"]
+    out = np.asarray(generate(model, params, batch, n_new))
+    assert out.shape == (len(lens), t0 + n_new)
+    for i, p in enumerate(prompts):
+        solo = np.asarray(generate(model, params, p[None], n_new))[0]
+        np.testing.assert_array_equal(
+            out[i, lens[i]: lens[i] + n_new],
+            solo[lens[i]: lens[i] + n_new],
+        )
+        # prompt preserved; ragged rows keep trailing padding
+        np.testing.assert_array_equal(out[i, : lens[i]], p)
+        assert (out[i, lens[i] + n_new:] == PAD).all()
+
+
+def test_generate_rejects_left_or_interior_padding(rng):
+    """Padding before or between real tokens has no consistent cache
+    slot — still a hard error (the original contract, narrowed to the
+    cases that are actually unservable)."""
     from examples.lm.generate import generate
 
     model = make_model()
-    prompt = jnp.asarray([[PAD, 3, 4]], jnp.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 3), jnp.int32)
+    )["params"]
+    for bad in ([[PAD, 3, 4]], [[3, PAD, 4]], [[PAD, PAD, PAD]]):
+        with pytest.raises(ValueError, match="padding"):
+            generate(model, params, jnp.asarray(bad, jnp.int32), 2)
+
+
+def test_generate_sampling_seeded_and_shared(rng):
+    """Temperature/top-k sampling is seeded (same rng -> same tokens)
+    and runs through the serve tier's shared helper."""
+    from examples.lm.generate import generate
+
+    model = make_model()
+    prompt = jnp.asarray(rng.randint(1, V, size=(2, 4)).astype(np.int32))
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
-    with pytest.raises(ValueError, match="padding"):
-        generate(model, params, prompt, 2)
+    a = np.asarray(generate(model, params, prompt, 6, temperature=0.7,
+                            top_k=5, rng=jax.random.PRNGKey(11)))
+    b = np.asarray(generate(model, params, prompt, 6, temperature=0.7,
+                            top_k=5, rng=jax.random.PRNGKey(11)))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="rng"):
+        generate(model, params, prompt, 2, temperature=0.7)
 
 
 def test_decode_rejects_bias_and_missing_positions(rng):

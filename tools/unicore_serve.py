@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Repo-local launcher for ``unicore-serve`` (see unicore_tpu/serve/cli.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from unicore_tpu.serve.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -279,6 +279,7 @@ class SelfMultiheadAttention(nn.Module):
         causal: bool = False,
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
+        paged=None,
     ):
         """``decode=True`` enables KV-cache incremental decoding (beyond
         the reference, which is a trainer only): the first call (flax
@@ -287,7 +288,16 @@ class SelfMultiheadAttention(nn.Module):
         step's k/v at the running index and attends the new queries over
         the whole cache with bottom-right causal masking.  ``positions``
         [T] are the global positions of the current tokens (drives RoPE;
-        defaults to arange)."""
+        defaults to arange).  A 2-D ``positions`` [B, T] makes the cache
+        RAGGED: each row's tokens write at (and attend up to) their own
+        per-sequence positions, with -1 marking inactive (padded) rows —
+        the right-padded-prompt prefill path.
+
+        ``paged`` (a :class:`unicore_tpu.serve.attention.PagedMeta`, with
+        ``decode=True``) switches from the per-call dense cache to the
+        serve tier's shared paged KV pool: k/v write into pool pages at
+        ``paged.slot_mapping`` and attention gathers each sequence's
+        pages through its page table (collection ``"pagedkv"``)."""
         bsz, tgt_len, embed_dim = query.shape
         assert embed_dim == self.embed_dim
         head_dim = self.embed_dim // self.num_heads
@@ -334,7 +344,16 @@ class SelfMultiheadAttention(nn.Module):
                     "global positions of the current tokens) — without "
                     "them every step would rotate at position 0"
                 )
-            o = self._decode_attend(q, k, v, scaling)
+            if paged is not None:
+                if positions is None and not self.is_initializing():
+                    raise ValueError(
+                        "paged decode requires positions= ([B, T] global "
+                        "positions of the current tokens; they drive both "
+                        "the causal mask and the page-slot bookkeeping)"
+                    )
+                o = self._paged_attend(q, k, v, scaling, paged, positions)
+            else:
+                o = self._decode_attend(q, k, v, scaling, positions)
             o = o.reshape(bsz, tgt_len, embed_dim)
             return nn.Dense(
                 self.embed_dim, use_bias=self.bias, kernel_init=bert_init,
@@ -363,19 +382,26 @@ class SelfMultiheadAttention(nn.Module):
             return o, attn_weights, probs
         return o
 
-    def _decode_attend(self, q, k, v, scaling):
+    def _decode_attend(self, q, k, v, scaling, positions=None):
         """KV-cache attention (cache collection: cached_key/cached_value/
         cache_index, the flax decoding idiom).  The flax-init pass sizes
         the cache from the prototype input's length and returns plain
         causal attention; subsequent mutable-"cache" calls append k/v at
-        the running index and attend over the whole cache."""
+        the running index and attend over the whole cache.
+
+        The cache carries ONE slot beyond the prototype capacity: a
+        trash slot that ragged writes (2-D ``positions``, -1 = inactive
+        row) park pad tokens' k/v in.  It is unattendable by
+        construction — every mask compares columns against a position
+        strictly below it."""
         import jax
 
         is_initialized = self.has_variable("cache", "cached_key")
+        cap = k.shape[:1] + (k.shape[1] + 1,) + k.shape[2:]
         cached_key = self.variable("cache", "cached_key", jnp.zeros,
-                                   k.shape, k.dtype)
+                                   cap, k.dtype)
         cached_value = self.variable("cache", "cached_value", jnp.zeros,
-                                     v.shape, v.dtype)
+                                     cap, v.dtype)
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
@@ -387,6 +413,40 @@ class SelfMultiheadAttention(nn.Module):
             p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
             return jnp.einsum("bhqk,bkhd->bqhd", p, v)
         idx = cache_index.value
+        if positions is not None and positions.ndim == 2:
+            # ragged path: row r of sequence b writes at its OWN global
+            # position (slot == position), inactive rows (-1) at the
+            # trash slot; each row attends keys <= its position
+            bsz, tgt_len = positions.shape
+            trash = cached_key.value.shape[1] - 1
+            slots = jnp.where(positions >= 0, positions, trash)
+            flat = (jnp.arange(bsz, dtype=jnp.int32)[:, None]
+                    * (trash + 1) + slots).reshape(-1)
+
+            def scatter(cached, new):
+                flat_pool = cached.reshape((-1,) + cached.shape[2:])
+                flat_pool = flat_pool.at[flat].set(
+                    new.astype(cached.dtype).reshape(
+                        (-1,) + new.shape[2:])
+                )
+                return flat_pool.reshape(cached.shape)
+
+            k_all = scatter(cached_key.value, k)
+            v_all = scatter(cached_value.value, v)
+            cached_key.value = k_all
+            cached_value.value = v_all
+            cache_index.value = jnp.maximum(
+                idx, jnp.max(positions) + 1
+            ).astype(jnp.int32)
+            cols = jnp.arange(k_all.shape[1], dtype=jnp.int32)
+            mask = jnp.where(
+                cols[None, None, None, :] > positions[:, None, :, None],
+                -1e30, 0.0,
+            )
+            s = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k_all)
+            s = s + mask
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v_all)
         k_all = jax.lax.dynamic_update_slice(
             cached_key.value, k.astype(cached_key.value.dtype),
             (0, idx, 0, 0),
@@ -402,6 +462,45 @@ class SelfMultiheadAttention(nn.Module):
         s = s + _decode_mask(idx, q.shape[1], k_all.shape[1])[None, None]
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v_all)
+
+    def _paged_attend(self, q, k, v, scaling, paged, positions):
+        """Serve-tier attention over the shared paged KV pool: this
+        step's k/v scatter into pool pages at ``paged.slot_mapping`` and
+        each sequence attends the pages its table names, masked to its
+        own positions (``unicore_tpu/serve/attention.py`` owns the math
+        and the eager/Pallas dispatch).  Pool buffers live in collection
+        ``"pagedkv"`` — one [num_slots, H, Dh] pair per layer, allocated
+        once at engine init and donated through every jitted step."""
+        head_dim = self.embed_dim // self.num_heads
+        is_initialized = self.has_variable("pagedkv", "k_pages")
+        nslots = None if is_initialized else int(paged.num_slots)
+        k_pages = self.variable("pagedkv", "k_pages", jnp.zeros,
+                                (nslots, self.num_heads, head_dim), k.dtype)
+        v_pages = self.variable("pagedkv", "v_pages", jnp.zeros,
+                                (nslots, self.num_heads, head_dim), v.dtype)
+        if not is_initialized:
+            import jax
+
+            from unicore_tpu.utils import causal_iota_mask
+
+            s = jnp.einsum("bqhd,bkhd->bhqk", q * scaling, k)
+            s = s + causal_iota_mask(q.shape[1], k.shape[1])[None, None]
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        from unicore_tpu.serve.attention import paged_attention
+
+        flat_k = k.astype(k_pages.value.dtype).reshape(
+            -1, self.num_heads, head_dim)
+        flat_v = v.astype(v_pages.value.dtype).reshape(
+            -1, self.num_heads, head_dim)
+        k_pages.value = k_pages.value.at[paged.slot_mapping].set(flat_k)
+        v_pages.value = v_pages.value.at[paged.slot_mapping].set(flat_v)
+        return paged_attention(
+            q, k_pages.value, v_pages.value,
+            page_table=paged.page_table, positions=positions,
+            lengths=paged.lengths, page_size=paged.page_size,
+            scale=scaling,
+        )
 
 
 def _decode_mask(idx, tgt_len, cache_len):

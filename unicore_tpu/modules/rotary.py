@@ -21,30 +21,37 @@ import numpy as np
 
 def rotary_cos_sin(seq_len, dim, base=10000.0, positions=None,
                    dtype=jnp.float32):
-    """cos/sin tables ``[T, dim//2]``.  ``positions`` (optional ``[T]``)
-    overrides ``arange(T)`` — sequence-parallel callers pass their
-    shard's global offsets."""
+    """cos/sin tables ``[T, dim//2]`` (or ``[B, T, dim//2]`` for per-
+    sequence positions).  ``positions`` (optional ``[T]`` shared, or
+    ``[B, T]`` ragged — incremental decode over right-padded prompts
+    rotates each sequence at its own offset) overrides ``arange(T)`` —
+    sequence-parallel callers pass their shard's global offsets.
+    Negative positions (inactive/padded rows, masked downstream) clamp
+    to 0 so the angle tables stay finite."""
     half = dim // 2
     inv_freq = 1.0 / (base ** (np.arange(0, half, dtype=np.float64) / half))
     inv_freq = jnp.asarray(inv_freq, jnp.float32)
     if positions is None:
         positions = jnp.arange(seq_len, dtype=jnp.float32)
     else:
-        positions = positions.astype(jnp.float32)
-    angles = positions[:, None] * inv_freq[None, :]  # [T, half]
+        positions = jnp.maximum(positions, 0).astype(jnp.float32)
+    angles = positions[..., None] * inv_freq  # [..., T, half]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
 def apply_rotary(x, cos, sin):
-    """Rotate ``x`` [B, T, H, D] by per-position angles (cos/sin [T, D//2]).
+    """Rotate ``x`` [B, T, H, D] by per-position angles (cos/sin
+    [T, D//2] shared, or [B, T, D//2] per-sequence).
 
     fp32 rotation regardless of input dtype (the angle tables lose too
     much phase accuracy in bf16 at long T), cast back on return."""
     half = x.shape[-1] // 2
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
-    c = cos.astype(jnp.float32)[None, :, None, :]
-    s = sin.astype(jnp.float32)[None, :, None, :]
+    c = cos.astype(jnp.float32)[..., None, :]
+    s = sin.astype(jnp.float32)[..., None, :]
+    if c.ndim == 3:  # shared [T, 1, half]: add the batch axis
+        c, s = c[None], s[None]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
 

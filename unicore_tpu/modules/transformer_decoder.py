@@ -58,6 +58,7 @@ class TransformerDecoderLayer(nn.Module):
         causal: bool = False,
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
+        paged=None,
     ):
         act = get_activation_fn(self.activation_fn)
 
@@ -79,7 +80,7 @@ class TransformerDecoderLayer(nn.Module):
         )(x, key_padding_mask=None if decode else padding_mask,
           attn_bias=attn_bias,
           deterministic=deterministic, causal=causal, decode=decode,
-          positions=positions)
+          positions=positions, paged=paged)
         x = drop(x, self.dropout)
         x = residual + x
         if self.post_ln:
@@ -151,6 +152,7 @@ class TransformerDecoder(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
+        paged=None,
     ):
         if decode and self.rel_pos:
             raise NotImplementedError(
@@ -209,7 +211,7 @@ class TransformerDecoder(nn.Module):
                 name=f"layers_{i}",
             )(x, encoder_out, attn_mask, padding_mask, encoder_attn_mask,
               encoder_padding_mask, deterministic, self.auto_regressive,
-              decode, positions)
+              decode, positions, paged=paged)
 
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)

@@ -1,0 +1,196 @@
+"""Ragged paged-attention decode kernel (Pallas/TPU).
+
+One grid program per sequence: the program walks that sequence's page
+table (scalar-prefetched into SMEM), DMAs each block of
+``pages_per_block`` KV pages HBM -> VMEM scratch, and folds them into an
+online-softmax accumulator — the ``[B, S, H, D]`` gathered key/value
+tensor the eager path materializes never exists, and per-sequence
+lengths make the work RAGGED: a sequence holding 3 pages stops after 3
+DMAs regardless of the table width (the "Ragged Paged Attention" shape,
+arxiv 2604.15464).
+
+Decode-step only (``T == 1``): prefill has enough arithmetic intensity
+that the gather + einsum composition feeds the MXU well; the decode
+step is gather-bound, which is exactly what the manual DMA pipeline
+addresses.  Dispatch (serve/attention.py) gates on ``use_pallas`` + the
+autotuner verdict and compile-probes fail-open, so this kernel can only
+ever replace the eager path where it lowers and measures faster.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from unicore_tpu.ops.backend import (
+    kernel_probe_ok,
+    pallas_interpret,
+    tpu_compiler_params,
+)
+
+# scoped-VMEM budget for the two KV scratch buffers (the rest of the
+# stack — q, out, accumulators — is KBs); same conservatism as the
+# softmax_dropout block heuristic
+_SCRATCH_BUDGET_BYTES = 8 << 20
+
+
+def pick_pages_per_block(num_table_pages, page_size, head_dim, tuned=None,
+                         num_heads=8, itemsize=2):
+    """Pages DMA'd per online-softmax block.  A tuned (validated) config
+    wins; the heuristic targets ~256 gathered slots per block — enough
+    rows to amortize the DMA issue latency without blowing VMEM."""
+    def fits(pp):
+        return (2 * pp * page_size * num_heads * head_dim * itemsize
+                <= _SCRATCH_BUDGET_BYTES)
+
+    if tuned is not None and fits(tuned):
+        return int(tuned)
+    pp = max(1, min(int(num_table_pages), -(-256 // int(page_size))))
+    while pp > 1 and not fits(pp):
+        pp -= 1
+    return pp
+
+
+def _kernel(pt_ref, len_ref, q_ref, kp_hbm, vp_hbm, o_ref, k_scr, v_scr,
+            sems, *, page_size, pages_per_block, scale):
+    b = pl.program_id(0)
+    length = len_ref[b]
+    n_table = pt_ref.shape[1]
+    blk_slots = pages_per_block * page_size
+    n_blocks = pl.cdiv(length, blk_slots)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
+    heads, d = q.shape
+
+    def body(i, carry):
+        m, l, acc = carry
+        # issue all this block's page DMAs, then wait: table rows are
+        # padded with the trash page 0, so a clamped out-of-range read
+        # fetches page 0 — always a valid pool page, masked below
+        copies = []
+        for j in range(pages_per_block):
+            page = pt_ref[b, jnp.minimum(i * pages_per_block + j,
+                                         n_table - 1)]
+            for src, dst, s in ((kp_hbm, k_scr, 0), (vp_hbm, v_scr, 1)):
+                cp = pltpu.make_async_copy(
+                    src.at[page], dst.at[j], sems.at[s, j]
+                )
+                cp.start()
+                copies.append(cp)
+        for cp in copies:
+            cp.wait()
+        k = k_scr[...].astype(jnp.float32).reshape(blk_slots, heads, d)
+        v = v_scr[...].astype(jnp.float32).reshape(blk_slots, heads, d)
+        s = jax.lax.dot_general(  # [H, blk]: q[h,:] . k[s,h,:] per head
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = i * blk_slots + jax.lax.broadcasted_iota(
+            jnp.int32, (1, blk_slots), 1
+        )
+        s = jnp.where(pos < length, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(  # [H, D]: p[h,:] . v[s,h,:] per head
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    init = (
+        jnp.full((heads, 1), -1e30, jnp.float32),
+        jnp.zeros((heads, 1), jnp.float32),
+        jnp.zeros((heads, d), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, init)
+    # inactive batch slots (length 0) never enter the loop; keep them
+    # finite instead of 0/0
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _call(q3, k_pages4, v_pages4, page_table, lengths, *, page_size,
+          pages_per_block, scale):
+    bsz, heads, d = q3.shape
+    qo_spec = pl.BlockSpec((1, heads, d), lambda b, pt, ln: (b, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bsz,),
+        in_specs=[
+            qo_spec,
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=qo_spec,
+        scratch_shapes=[
+            pltpu.VMEM((pages_per_block, page_size, heads, d), q3.dtype),
+            pltpu.VMEM((pages_per_block, page_size, heads, d), q3.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_block)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _kernel, page_size=page_size, pages_per_block=pages_per_block,
+            scale=float(scale),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, heads, d), q3.dtype),
+        interpret=pallas_interpret(),
+        compiler_params=tpu_compiler_params(
+            # the scratch/DMA pattern serializes programs on-core anyway
+            dimension_semantics=("arbitrary",),
+        ),
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q3, k_pages4, v_pages4)
+
+
+def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                            page_size, scale, pages_per_block=None):
+    """Paged decode attention: q [B, 1, H, D], flat pools
+    [num_slots, H, D], page_table [B, P] (pad rows with page 0),
+    lengths [B] (0 = inactive slot).  Returns [B, 1, H, D]."""
+    assert q.shape[1] == 1, "the ragged kernel is decode-step only"
+    heads, d = q.shape[2], q.shape[3]
+    num_pages = k_pages.shape[0] // page_size
+    if pages_per_block is None:
+        pages_per_block = pick_pages_per_block(
+            page_table.shape[1], page_size, d, num_heads=heads,
+            itemsize=q.dtype.itemsize,
+        )
+    out = _call(
+        q[:, 0],
+        k_pages.reshape(num_pages, page_size, heads, d),
+        v_pages.reshape(num_pages, page_size, heads, d),
+        page_table, lengths,
+        page_size=page_size, pages_per_block=pages_per_block, scale=scale,
+    )
+    return out[:, None]
+
+
+def probe_ok(dtype, bsz, heads, d, num_pages, page_size, table_pages,
+             pages_per_block):
+    """Fail-open compile probe (see ``backend.kernel_probe_ok``): lower
+    a single-sequence config with the production page_size/heads/head-dim
+    and block shape — the dims that pick the DMA/layout lowering; grid
+    size (batch) and pool page count shrink to minimum."""
+    del bsz, num_pages, table_pages  # grid/pool/table size never
+    # changes the lowering; only the block shape and dtypes do
+    key = ("paged_attention", str(dtype), heads, d, int(page_size),
+           int(pages_per_block))
+
+    def build():
+        pp = int(pages_per_block)
+        kp = jnp.zeros(((pp + 1) * page_size, heads, d), dtype)
+        q = jnp.zeros((1, 1, heads, d), dtype)
+        pt = jnp.zeros((1, max(pp, 1)), jnp.int32)
+        ln = jnp.full((1,), page_size, jnp.int32)
+        fn = functools.partial(
+            ragged_decode_attention, page_size=int(page_size),
+            scale=1.0, pages_per_block=pp,
+        )
+        jax.jit(fn).lower(q, kp, kp, pt, ln).compile()
+
+    return kernel_probe_ok(key, build)

@@ -44,8 +44,8 @@ from unicore_tpu.ops.tuning.cache import (  # noqa: F401
     TuneCache, bucket_key, env_fingerprint,
 )
 from unicore_tpu.ops.tuning.candidates import (  # noqa: F401
-    OPS, PRESETS, describe_config, flash_workload, ln_workload, pow2_bucket,
-    sd_workload,
+    OPS, PRESETS, describe_config, flash_workload, ln_workload,
+    paged_workload, pow2_bucket, sd_workload,
 )
 
 logger = logging.getLogger(__name__)
@@ -253,3 +253,25 @@ def tuned_q_blk(q, decision):
     if blk < 1 or blk > q or q % blk:
         return None
     return blk
+
+
+def paged_decision(q_shape, table_pages, page_size, dtype,
+                   allow_tune=False):
+    """Serve-tier ragged decode attention (q_shape [B, 1, H, D])."""
+    return _decision("paged_attention", paged_workload(
+        q_shape, table_pages, page_size, dtype,
+    ), allow_tune=allow_tune)
+
+
+def tuned_pages_per_block(table_pages, decision):
+    """Validate a cached paged-attention config against the actual
+    table width; None -> use the heuristic."""
+    if not isinstance(decision, dict):
+        return None
+    try:
+        pp = int(decision["pages_per_block"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if pp < 1 or pp > table_pages:
+        return None
+    return pp

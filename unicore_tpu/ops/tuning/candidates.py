@@ -297,6 +297,110 @@ def _flash_shrink(wl):
 
 
 # ---------------------------------------------------------------------------
+# paged attention (serve-tier ragged decode step)
+# ---------------------------------------------------------------------------
+
+
+def paged_workload(q_shape, table_pages, page_size, dtype):
+    """q_shape: module layout [B, 1, H, D] (decode step)."""
+    return {
+        "op": "paged_attention",
+        "q_shape": tuple(int(s) for s in q_shape),
+        "table_pages": int(table_pages),
+        "page_size": int(page_size),
+        "dtype": str(dtype),
+    }
+
+
+def _paged_bucket(wl):
+    bsz, _, heads, d = wl["q_shape"]
+    # batch is bucketed (the serve engine's fixed max_batch makes it
+    # near-static anyway); heads/head-dim/page-size exact — they pick
+    # the scratch layout and DMA shape
+    return ("paged_attention", wl["dtype"], pow2_bucket(bsz), heads, d,
+            wl["page_size"], pow2_bucket(wl["table_pages"]))
+
+
+def _paged_candidates(wl):
+    from unicore_tpu.ops.pallas.paged_attention import pick_pages_per_block
+
+    _, _, heads, d = wl["q_shape"]
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(wl["dtype"]).itemsize
+    heuristic = pick_pages_per_block(
+        wl["table_pages"], wl["page_size"], d, num_heads=heads,
+        itemsize=itemsize,
+    )
+    pps = [heuristic]
+    for pp in (1, 2, 4, 8):
+        if pp <= wl["table_pages"] and pp not in pps:
+            pps.append(pp)
+    return ["eager"] + [
+        {"pages_per_block": pp} for pp in pps[:MAX_KERNEL_CANDIDATES]
+    ]
+
+
+def _paged_args(wl):
+    import jax.numpy as jnp
+
+    bsz, _, heads, d = wl["q_shape"]
+    pages, ps = wl["table_pages"], wl["page_size"]
+    num_pages = bsz * pages + 1  # page 0 reserved (trash)
+    q = _zeros(wl["q_shape"], wl["dtype"])
+    pool = _zeros((num_pages * ps, heads, d), wl["dtype"])
+    table = (1 + jnp.arange(bsz * pages, dtype=jnp.int32).reshape(
+        bsz, pages))
+    lengths = jnp.full((bsz,), pages * ps, jnp.int32)
+    return q, pool, table, lengths
+
+
+def _paged_runner(wl, config):
+    import jax
+    import jax.numpy as jnp
+
+    q, pool, table, lengths = _paged_args(wl)
+    ps = wl["page_size"]
+    d = wl["q_shape"][3]
+    scale = d ** -0.5
+
+    if config == "eager":
+        from unicore_tpu.serve.attention import paged_attention_reference
+
+        positions = (lengths - 1)[:, None]
+
+        def run(q_):
+            return paged_attention_reference(
+                q_, pool, pool, table, positions, lengths, ps, scale
+            ).astype(jnp.float32)
+
+        return _aot(run, q)
+
+    from unicore_tpu.ops.pallas.paged_attention import (
+        ragged_decode_attention,
+    )
+
+    pp = int(config["pages_per_block"])
+
+    def run(q_):
+        return ragged_decode_attention(
+            q_, pool, pool, table, lengths, page_size=ps, scale=scale,
+            pages_per_block=pp,
+        ).astype(jnp.float32)
+
+    return _aot(run, q)
+
+
+def _paged_shrink(wl):
+    bsz = min(wl["q_shape"][0], 2)
+    return dict(
+        wl,
+        q_shape=(bsz,) + wl["q_shape"][1:],
+        table_pages=min(wl["table_pages"], 4),
+    )
+
+
+# ---------------------------------------------------------------------------
 # layer_norm
 # ---------------------------------------------------------------------------
 
@@ -360,6 +464,10 @@ OPS = {
                     wl["hidden"]),
         _ln_candidates, _ln_runner, _ln_shrink,
     ),
+    "paged_attention": OpSpec(
+        "paged_attention", _paged_bucket, _paged_candidates, _paged_runner,
+        _paged_shrink,
+    ),
 }
 
 
@@ -387,4 +495,6 @@ PRESETS = {
         (4, 2048, 12, 64), 2048, "bfloat16", causal=False, dropout_on=False,
     ),
     "layer_norm_bert": ln_workload(16384, 768, "bfloat16"),
+    # serve decode step: batch 8, 8 heads x 64, 16-token pages, 2k context
+    "paged_decode_b8": paged_workload((8, 1, 8, 64), 128, 16, "bfloat16"),
 }

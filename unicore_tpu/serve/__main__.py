@@ -1,0 +1,5 @@
+import sys
+
+from unicore_tpu.serve.cli import main
+
+sys.exit(main())

@@ -1,0 +1,224 @@
+"""``unicore-serve``: offline batch generation from a trained checkpoint
+through the continuous-batching engine.
+
+Two sources of model + prompts:
+
+- ``--checkpoint ckpt.pt --dict dict.txt`` — serve a trained
+  ``transformer_lm`` checkpoint (the framework's pickled-numpy format;
+  convert torch checkpoints first, see checkpoint_utils).  Prompts come
+  from ``--prompts FILE``: one request per line, whitespace-separated
+  token ids (tokenization is a data-pipeline concern, not a serving
+  one).
+- ``--demo`` — a tiny randomly-initialized model + random prompts of
+  mixed lengths: the zero-setup smoke path CI drives (at least 3
+  concurrent mixed-length requests through the full
+  admit/prefill/decode/evict machinery on CPU).
+
+Output: one JSON object (``--json FILE`` or stdout) with per-request
+generated ids, finish reasons, TTFT, and the engine's aggregate stats.
+"""
+
+import argparse
+import json
+import logging
+import sys
+
+import numpy as np
+
+logger = logging.getLogger("unicore_tpu.serve.cli")
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        "unicore-serve",
+        description="offline batch generation via the paged-KV "
+                    "continuous-batching engine (docs/serving.md)",
+    )
+    src = p.add_argument_group("model source")
+    src.add_argument("--checkpoint", help="framework checkpoint (.pt)")
+    src.add_argument("--dict", dest="dict_path",
+                     help="dict.txt the model was trained with")
+    src.add_argument("--demo", action="store_true",
+                     help="tiny random model + random prompts (smoke)")
+    req = p.add_argument_group("requests")
+    req.add_argument("--prompts",
+                     help="file of whitespace-separated token-id lines")
+    req.add_argument("--num-requests", type=int, default=4,
+                     help="demo mode: how many random requests")
+    req.add_argument("--prompt-len-range", default="3,17",
+                     help="demo mode: 'lo,hi' prompt lengths")
+    req.add_argument("--max-new-tokens", type=int, default=16)
+    req.add_argument("--temperature", type=float, default=0.0)
+    req.add_argument("--top-k", type=int, default=0)
+    req.add_argument("--seed", type=int, default=1)
+    eng = p.add_argument_group("engine")
+    eng.add_argument("--page-size", type=int, default=16)
+    eng.add_argument("--num-pages", type=int, default=64)
+    eng.add_argument("--max-batch", type=int, default=8)
+    eng.add_argument("--prefill-token-budget", type=int, default=512)
+    p.add_argument("--json", dest="json_out",
+                   help="write the report here instead of stdout")
+    return p
+
+
+def _demo_model(seed):
+    import jax
+    import jax.numpy as jnp
+
+    from examples.lm.model import TransformerLMModel
+
+    model = TransformerLMModel(
+        vocab_size=97, padding_idx=0, decoder_layers=2,
+        decoder_embed_dim=64, decoder_ffn_embed_dim=128,
+        decoder_attention_heads=4, max_seq_len=256,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, rel_pos=False, abs_pos=False, rotary=True,
+    )
+    proto = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), proto)["params"]
+    return model, params
+
+
+def _checkpoint_model(path, dict_path):
+    import jax
+    import jax.numpy as jnp
+
+    from examples.lm.model import TransformerLMModel  # registers the arch
+    from unicore_tpu.checkpoint_utils import load_checkpoint_to_cpu
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.models import ARCH_MODEL_REGISTRY
+
+    del TransformerLMModel
+    state = load_checkpoint_to_cpu(path)
+    args = state["args"]
+    dictionary = Dictionary.load(dict_path)
+
+    class _Task:
+        pass
+
+    task = _Task()
+    task.dictionary = dictionary
+    arch = getattr(args, "arch", "transformer_lm")
+    model = ARCH_MODEL_REGISTRY[arch].build_model(args, task)
+    # checkpoint "model" is the TRAIN state {opt_state, params, step};
+    # serving needs the fp32 master params tree (numpy leaves upload on
+    # first use)
+    from unicore_tpu.checkpoint_utils import ShardedLeaf
+
+    tree = state["model"]["params"]
+    if any(isinstance(leaf, ShardedLeaf)
+           for leaf in jax.tree_util.tree_leaves(tree)):
+        raise SystemExit(
+            f"{path} is a SHARDED checkpoint (FSDP/TP run: params live "
+            "in .shard* sibling files); consolidate it first — resume "
+            "the run on one host and save, or load via "
+            "Trainer.load_checkpoint"
+        )
+    params = jax.tree_util.tree_map(jnp.asarray, tree)
+    return model, params
+
+
+def _demo_requests(args, vocab, rng):
+    from unicore_tpu.serve.scheduler import Request
+
+    lo, hi = (int(x) for x in args.prompt_len_range.split(","))
+    reqs = []
+    for i in range(args.num_requests):
+        n = int(rng.integers(lo, hi))
+        prompt = rng.integers(1, vocab, size=(n,)).tolist()
+        reqs.append(Request(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            seed=args.seed + i, request_id=f"demo-{i}",
+        ))
+    return reqs
+
+
+def _file_requests(args, path):
+    from unicore_tpu.serve.scheduler import Request
+
+    reqs = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            toks = [int(t) for t in line.split()]
+            if not toks:
+                continue
+            reqs.append(Request(
+                prompt=toks, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                seed=args.seed + i, request_id=f"req-{i}",
+            ))
+    return reqs
+
+
+def main(argv=None):
+    logging.basicConfig(
+        format="%(asctime)s | %(levelname)s | %(name)s | %(message)s",
+        level="INFO", stream=sys.stderr,
+    )
+    args = make_parser().parse_args(argv)
+    if not args.demo and not args.checkpoint:
+        raise SystemExit("need --checkpoint (with --dict) or --demo")
+
+    from unicore_tpu.serve.engine import ServeEngine
+
+    if args.demo:
+        model, params = _demo_model(args.seed)
+        rng = np.random.default_rng(args.seed)
+        requests = (_file_requests(args, args.prompts) if args.prompts
+                    else _demo_requests(args, model.vocab_size, rng))
+    else:
+        if not args.dict_path:
+            raise SystemExit("--checkpoint needs --dict")
+        if not args.prompts:
+            raise SystemExit("--checkpoint needs --prompts")
+        model, params = _checkpoint_model(args.checkpoint, args.dict_path)
+        requests = _file_requests(args, args.prompts)
+
+    for req in requests:
+        bad = [t for t in req.prompt if not 0 <= t < model.vocab_size]
+        if bad:
+            raise SystemExit(
+                f"{req.request_id}: prompt ids {bad[:5]} outside the "
+                f"model's vocab [0, {model.vocab_size}) — wrong "
+                "dictionary for this checkpoint?"
+            )
+
+    engine = ServeEngine(
+        model, params, num_pages=args.num_pages, page_size=args.page_size,
+        max_batch=args.max_batch,
+        prefill_token_budget=args.prefill_token_budget,
+    )
+    logger.info(
+        "serving %d request(s): pool %d pages x %d slots, max batch %d",
+        len(requests), args.num_pages, args.page_size, args.max_batch,
+    )
+    results = engine.generate(requests)
+    report = {
+        "results": [
+            {
+                "request_id": r.request_id,
+                "prompt": r.prompt,
+                "tokens": r.tokens,
+                "finish_reason": r.finish_reason,
+                "ttft_ms": round(r.ttft_ms, 2),
+                "evictions": r.evictions,
+            }
+            for r in results
+        ],
+        "stats": {k: (round(v, 4) if isinstance(v, float) else v)
+                  for k, v in engine.stats.items()},
+    }
+    text = json.dumps(report, indent=2)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+        logger.info("wrote %s", args.json_out)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,398 @@
+"""ServeEngine: batched offline/online generation over the paged pool.
+
+The engine owns the device side of serving: ONE decode-step executable
+(fixed ``max_batch`` rows, inactive rows masked through the trash page)
+and one prefill executable per pow2 prompt bucket, both jitted with the
+pool buffers DONATED — after warmup every step updates the KV pool
+in-place and allocates nothing.  Sampling (greedy/temperature/top-k,
+seeded per request) runs inside the step, so only the [B] sampled token
+ids cross the host boundary each iteration; the host loop needs them
+anyway to drive the scheduler.
+
+Metrics: per-request TTFT, aggregate decode tokens/sec, pool occupancy
+(peak + per-step into ``unicore_tpu.metrics`` when an aggregation
+context is active).
+"""
+
+import dataclasses
+import time
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+
+from .attention import PagedMeta
+from .kv_pool import PagedKVPool
+from .sampling import sample_tokens, step_keys
+from .scheduler import Scheduler
+
+
+@dataclasses.dataclass
+class ServeResult:
+    request_id: Optional[str]
+    prompt: List[int]
+    tokens: List[int]          # generated tokens (eos included if hit)
+    finish_reason: str         # "eos" | "length" | "capacity"
+    ttft_ms: float
+    evictions: int
+
+
+def _pow2_bucket(n, floor=8):
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServeEngine:
+    """Continuous-batching generation engine over a paged KV pool.
+
+    ``model`` is any decoder LM following the ``examples/lm`` contract
+    (``apply(variables, tokens, decode=True, positions=..., paged=...)``
+    returning [B, T, V] logits, plus ``max_seq_len``/``padding_idx``
+    attributes)."""
+
+    def __init__(self, model, params, *, num_pages=64, page_size=16,
+                 max_batch=8, prefill_token_budget=512, max_context=None,
+                 chaos_rate=0.0, chaos_rng=None):
+        self.model = model
+        self.params = params
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_batch = int(max_batch)
+        self.prefill_token_budget = int(prefill_token_budget)
+        cap = (self.num_pages - 1) * self.page_size
+        self.max_context = min(
+            int(max_context or model.max_seq_len), model.max_seq_len, cap
+        )
+        self.num_slots = self.num_pages * self.page_size
+        self.pool = PagedKVPool(self.num_pages, self.page_size)
+        self.table_width = self.pool.pages_for(self.max_context)
+        self.scheduler = Scheduler(
+            self.pool, self.max_batch,
+            prefill_token_budget=self.prefill_token_budget,
+            chaos_rate=chaos_rate, chaos_rng=chaos_rng,
+        )
+        self.pages = self._init_pages()
+        self._prefill_fns = {}
+        self._decode_fns = {}
+        self.stats = {
+            "prefills": 0, "decode_steps": 0, "decode_tokens": 0,
+            "generated_tokens": 0, "peak_pool_occupancy": 0.0,
+            "decode_time_s": 0.0, "wall_time_s": 0.0,
+        }
+
+    # -- pool buffers --------------------------------------------------
+
+    def _init_pages(self):
+        """Allocate the per-layer k/v page buffers once (eval_shape over
+        flax init — zero FLOPs, exactly like the dense ``init_cache``)."""
+        proto = jnp.zeros((1, 2), jnp.int32)
+        meta = PagedMeta(
+            page_table=jnp.zeros((1, self.table_width), jnp.int32),
+            slot_mapping=jnp.zeros((2,), jnp.int32),
+            lengths=jnp.ones((1,), jnp.int32),
+            page_size=self.page_size,
+            num_slots=self.num_slots,
+        )
+        shapes = jax.eval_shape(
+            lambda key, p: self.model.init(
+                key, p, decode=True, paged=meta,
+                positions=jnp.zeros((1, 2), jnp.int32),
+            ),
+            jax.random.PRNGKey(0), proto,
+        )["pagedkv"]
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+
+    # -- jitted steps --------------------------------------------------
+
+    @staticmethod
+    def _pick_tokens(logits, seeds, steps, temperature, top_k, sampling):
+        """``sampling`` is a TRACE-TIME mode: ``"greedy"`` (the engine
+        default) skips the whole sampling composition, ``"temp"`` skips
+        the full-vocab top-k sort, ``"topk"`` traces everything — the
+        variants compile separately and the host picks per step from
+        the live batch's request params (a row samples identically
+        under any variant that covers it)."""
+        if sampling == "greedy":
+            return jnp.argmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+        return sample_tokens(
+            logits, step_keys(seeds, steps), temperature, top_k,
+            use_top_k=sampling == "topk",
+        )
+
+    @staticmethod
+    def _sampling_mode(seqs):
+        if any(s.req.top_k > 0 and s.req.temperature > 0 for s in seqs):
+            return "topk"
+        if any(s.req.temperature > 0 for s in seqs):
+            return "temp"
+        return "greedy"
+
+    def _decode_step_fn(self, sampling):
+        fn = self._decode_fns.get(sampling)
+        if fn is None:
+            model, page_size = self.model, self.page_size
+
+            def step(params, pages, tokens, positions, page_table,
+                     slot_mapping, lengths, seeds, steps, temperature,
+                     top_k):
+                meta = PagedMeta(
+                    page_table=page_table, slot_mapping=slot_mapping,
+                    lengths=lengths, page_size=page_size,
+                )
+                logits, mutated = model.apply(
+                    {"params": params, "pagedkv": pages}, tokens,
+                    decode=True, positions=positions, paged=meta,
+                    mutable=["pagedkv"],
+                )
+                toks = self._pick_tokens(
+                    logits[:, -1], seeds, steps, temperature, top_k,
+                    sampling,
+                )
+                return toks, mutated["pagedkv"]
+
+            fn = self._decode_fns[sampling] = jax.jit(
+                step, donate_argnums=(1,)
+            )
+        return fn
+
+    def _prefill_fn(self, bucket, sampling):
+        key = (bucket, sampling)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model, page_size = self.model, self.page_size
+
+            def step(params, pages, tokens, positions, page_table,
+                     slot_mapping, lengths, seeds, steps, temperature,
+                     top_k):
+                meta = PagedMeta(
+                    page_table=page_table, slot_mapping=slot_mapping,
+                    lengths=lengths, page_size=page_size,
+                )
+                logits, mutated = model.apply(
+                    {"params": params, "pagedkv": pages}, tokens,
+                    decode=True, positions=positions, paged=meta,
+                    mutable=["pagedkv"],
+                )
+                # first token comes from the LAST VALID prompt row
+                last = logits[0, lengths[0] - 1][None]
+                toks = self._pick_tokens(
+                    last, seeds, steps, temperature, top_k, sampling
+                )
+                return toks, mutated["pagedkv"]
+
+            fn = self._prefill_fns[key] = jax.jit(
+                step, donate_argnums=(1,)
+            )
+        return fn
+
+    # -- host-side step assembly ---------------------------------------
+
+    def _padded_table(self, seq):
+        table = np.zeros((self.table_width,), np.int32)
+        pages = self.pool.page_table(seq.sid)
+        table[: len(pages)] = pages
+        return table
+
+    def _prefill(self, seq):
+        prefix = seq.prefix()
+        n = len(prefix)
+        bucket = _pow2_bucket(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prefix
+        positions = np.full((1, bucket), -1, np.int32)
+        positions[0, :n] = np.arange(n)
+        slot_mapping = np.zeros((bucket,), np.int32)
+        for r in range(n):
+            slot_mapping[r] = self.pool.slot(seq.sid, r)
+        req = seq.req
+        tok, self.pages = self._prefill_fn(
+            bucket, self._sampling_mode([seq]))(
+            self.params, self.pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._padded_table(seq)[None]),
+            jnp.asarray(slot_mapping),
+            jnp.asarray([n], jnp.int32),
+            jnp.asarray([req.seed], jnp.int32),
+            jnp.asarray([len(seq.generated)], jnp.int32),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+        )
+        self.stats["prefills"] += 1
+        self._emit(seq, int(np.asarray(tok)[0]))
+
+    def _decode(self, seqs):
+        B = self.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.full((B, 1), -1, np.int32)
+        tables = np.zeros((B, self.table_width), np.int32)
+        slot_mapping = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        seeds = np.zeros((B,), np.int32)
+        steps = np.zeros((B,), np.int32)
+        for b, seq in enumerate(seqs):
+            prefix = seq.prefix()
+            tokens[b, 0] = prefix[-1]
+            positions[b, 0] = len(prefix) - 1
+            tables[b] = self._padded_table(seq)
+            slot_mapping[b] = self.pool.slot(seq.sid, len(prefix) - 1)
+            lengths[b] = len(prefix)
+            temperature[b] = seq.req.temperature
+            top_k[b] = seq.req.top_k
+            seeds[b] = seq.req.seed
+            steps[b] = len(seq.generated)
+        sampling = self._sampling_mode(seqs)
+        t0 = time.perf_counter()
+        toks, self.pages = self._decode_step_fn(sampling)(
+            self.params, self.pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(tables), jnp.asarray(slot_mapping),
+            jnp.asarray(lengths), jnp.asarray(seeds),
+            jnp.asarray(steps), jnp.asarray(temperature),
+            jnp.asarray(top_k),
+        )
+        toks = np.asarray(toks)  # host sync: the scheduler needs them
+        self.stats["decode_time_s"] += time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        self.stats["decode_tokens"] += len(seqs)
+        for b, seq in enumerate(seqs):
+            self._emit(seq, int(toks[b]))
+
+    def _emit(self, seq, token):
+        """Append one sampled token and settle termination."""
+        seq.generated.append(token)
+        self.stats["generated_tokens"] += 1
+        if seq.first_token_at is None:
+            seq.first_token_at = time.perf_counter()
+            metrics.log_scalar(
+                "serve/ttft_ms",
+                (seq.first_token_at - seq.enqueued_at) * 1e3,
+            )
+        req = seq.req
+        if req.eos_id is not None and token == req.eos_id:
+            self.scheduler.finish(seq, "eos")
+        elif len(seq.generated) >= req.max_new_tokens:
+            self.scheduler.finish(seq, "length")
+        elif len(seq.prefix()) > self.max_context:
+            # the NEXT decode would need a KV slot at position
+            # max_context — beyond the table width; truncate here
+            self.scheduler.finish(seq, "capacity")
+
+    # -- public API ----------------------------------------------------
+
+    def generate(self, requests) -> List[ServeResult]:
+        """Run a batch of :class:`Request`s to completion; results come
+        back in request order."""
+        sched = self.scheduler
+        # validate EVERYTHING before enqueuing anything: a mid-list
+        # reject must not leave earlier requests queued as ghost work
+        # for the next generate() call
+        for req in requests:
+            if len(req.prompt) > self.max_context:
+                raise ValueError(
+                    f"prompt of {len(req.prompt)} tokens exceeds the "
+                    f"engine's context of {self.max_context} "
+                    "(num_pages * page_size and model.max_seq_len bound "
+                    "it); generation past the context is truncated with "
+                    'a "capacity" finish instead'
+                )
+            if not req.prompt:
+                raise ValueError("empty prompt")
+            if req.max_new_tokens < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if not 0 <= req.seed < 2 ** 31:
+                raise ValueError(
+                    f"seed {req.seed} out of the int32 sampling-key "
+                    "range [0, 2**31)"
+                )
+        seqs = []
+        for req in requests:
+            seq = sched.add(req)
+            seq.enqueued_at = time.perf_counter()
+            seqs.append(seq)
+        t0 = time.perf_counter()
+        try:
+            self._run_to_completion(sched)
+        except BaseException:
+            # mid-run failure (device OOM, interrupt): detach THIS
+            # call's unfinished sequences and free their pages so the
+            # engine stays usable — otherwise the next generate() would
+            # silently decode this call's ghosts against its pool
+            for seq in seqs:
+                if seq.done:
+                    continue
+                if seq in sched.running:
+                    sched.running.remove(seq)
+                    self.pool.free(seq.sid)
+                elif seq in sched.waiting:
+                    sched.waiting.remove(seq)
+            raise
+        self.stats["wall_time_s"] += time.perf_counter() - t0
+        self.stats["evictions"] = sched.num_evictions
+        if self.stats["decode_time_s"] > 0:
+            self.stats["decode_tokens_per_sec"] = (
+                self.stats["decode_tokens"] / self.stats["decode_time_s"]
+            )
+        # this call's Sequence objects carry their own terminal state —
+        # and draining them from sched.finished keeps a long-lived
+        # engine's memory flat across generate() calls
+        ours = set(id(s) for s in seqs)
+        sched.finished = [s for s in sched.finished if id(s) not in ours]
+        out = []
+        for seq in seqs:
+            assert seq.done, "generate() returned with an unfinished seq"
+            out.append(ServeResult(
+                request_id=seq.req.request_id,
+                prompt=list(seq.req.prompt),
+                tokens=list(seq.generated),
+                finish_reason=seq.finish_reason,
+                ttft_ms=(seq.first_token_at - seq.enqueued_at) * 1e3,
+                evictions=seq.evictions,
+            ))
+        return out
+
+    def _run_to_completion(self, sched):
+        stalled = 0
+        while sched.has_work():
+            # admit() hands back fresh AND resumed sequences — a resumed
+            # one re-prefills prompt+generated, recreating exactly the
+            # KV state its eviction dropped
+            admitted = sched.admit(bucket=_pow2_bucket)
+            for seq in admitted:
+                self._prefill(seq)
+            sched.chaos_preempt()
+            did_decode = False
+            if sched.running:
+                todo = sched.prepare_decode()
+                if todo:
+                    self._decode(todo)
+                    did_decode = True
+            self.stats["peak_pool_occupancy"] = max(
+                self.stats["peak_pool_occupancy"], self.pool.occupancy()
+            )
+            metrics.log_scalar(
+                "serve/pool_occupancy", self.pool.occupancy()
+            )
+            # an iteration may legitimately emit nothing when its only
+            # event was an eviction (chaos, or an exhaustion cascade
+            # that drained the batch): the freed pages guarantee the
+            # NEXT iteration admits.  Two empty iterations in a row
+            # cannot happen unless the scheduler is genuinely wedged.
+            stalled = 0 if (admitted or did_decode) else stalled + 1
+            if stalled >= 2 and sched.has_work():
+                raise RuntimeError(
+                    "scheduler stalled with work queued — this is a bug "
+                    "(the admission guard should make progress "
+                    "inevitable)"
+                )

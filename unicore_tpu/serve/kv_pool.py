@@ -1,0 +1,150 @@
+"""Paged KV-cache pool: host-side page accounting for the serve tier.
+
+The device buffers (one ``[num_pages * page_size, H, Dh]`` k/v pair per
+decoder layer, flax collection ``"pagedkv"``) are allocated ONCE at
+engine init and donated through every jitted step — zero reallocation
+after warmup.  This module owns everything about them that is NOT math:
+which pages belong to which sequence, in what order, and which are
+free.  It is pure Python over ints, so the allocation invariants are
+directly property-testable without a device.
+
+Design notes (after "Ragged Paged Attention", arxiv 2604.15464, and the
+vLLM paged-KV scheme):
+
+- **Page 0 is reserved as the trash page.**  Jitted steps always run at
+  a fixed batch/width, so inactive batch rows and padded prompt
+  positions still produce k/v writes; their ``slot_mapping`` entries
+  point into page 0, which no sequence ever owns and no mask ever
+  admits.  That keeps every scatter in-bounds without per-row cond.
+- Page tables are append-only per sequence: token at position ``p``
+  lives in the sequence's ``p // page_size``-th page at offset
+  ``p % page_size``, so the flat gathered layout is position-ordered by
+  construction and the causal mask is a plain position compare.
+- ``alloc``/``extend``/``free`` enforce strict invariants (no page in
+  two tables, no double-free, exhaustion raises :class:`PoolExhausted`)
+  instead of degrading silently — the scheduler's eviction logic is
+  built on top of these exceptions.
+"""
+
+
+class PoolExhausted(Exception):
+    """Raised when an alloc/extend needs more free pages than exist."""
+
+
+class PagedKVPool:
+    """Fixed-capacity page allocator with per-sequence page tables."""
+
+    def __init__(self, num_pages, page_size):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved trash page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list keeps recently-freed (cache-warm) pages hot
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._tables = {}  # seq_id -> [page, ...] in position order
+        self._lens = {}    # seq_id -> token count
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def num_usable_pages(self):
+        return self.num_pages - 1
+
+    @property
+    def num_free_pages(self):
+        return len(self._free)
+
+    def occupancy(self):
+        """Fraction of usable pages currently allocated."""
+        used = self.num_usable_pages - len(self._free)
+        return used / self.num_usable_pages
+
+    def pages_for(self, num_tokens):
+        """Pages a sequence of ``num_tokens`` tokens occupies."""
+        return -(-int(num_tokens) // self.page_size)
+
+    def can_alloc(self, num_tokens):
+        return self.pages_for(num_tokens) <= len(self._free)
+
+    # -- alloc / extend / free -----------------------------------------
+
+    def alloc(self, seq_id, num_tokens):
+        """Allocate pages for a new sequence of ``num_tokens`` tokens."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.pages_for(num_tokens)
+        if need > len(self._free):
+            raise PoolExhausted(
+                f"need {need} pages for {num_tokens} tokens, "
+                f"{len(self._free)} free"
+            )
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._lens[seq_id] = int(num_tokens)
+        return list(self._tables[seq_id])
+
+    def extend(self, seq_id, num_tokens=1):
+        """Grow a sequence by ``num_tokens``; allocates new pages only
+        when a token crosses a page boundary."""
+        if seq_id not in self._tables:
+            raise KeyError(f"sequence {seq_id!r} not allocated")
+        new_len = self._lens[seq_id] + int(num_tokens)
+        need = self.pages_for(new_len) - len(self._tables[seq_id])
+        if need > len(self._free):
+            raise PoolExhausted(
+                f"sequence {seq_id!r} needs {need} more page(s), "
+                f"{len(self._free)} free"
+            )
+        for _ in range(max(need, 0)):
+            self._tables[seq_id].append(self._free.pop())
+        self._lens[seq_id] = new_len
+        return list(self._tables[seq_id])
+
+    def free(self, seq_id):
+        """Return all of a sequence's pages to the free list."""
+        if seq_id not in self._tables:
+            raise KeyError(f"sequence {seq_id!r} not allocated "
+                           "(double free?)")
+        pages = self._tables.pop(seq_id)
+        del self._lens[seq_id]
+        self._free.extend(reversed(pages))
+        return pages
+
+    # -- lookups -------------------------------------------------------
+
+    def page_table(self, seq_id):
+        return list(self._tables[seq_id])
+
+    def seq_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def seq_ids(self):
+        return list(self._tables)
+
+    def slot(self, seq_id, position):
+        """Flat pool slot (page * page_size + offset) of ``position``."""
+        table = self._tables[seq_id]
+        page_idx, offset = divmod(int(position), self.page_size)
+        if page_idx >= len(table):
+            raise IndexError(
+                f"position {position} beyond the {len(table)} page(s) of "
+                f"sequence {seq_id!r}"
+            )
+        return table[page_idx] * self.page_size + offset
+
+    def check_invariants(self):
+        """Internal-consistency audit (cheap; tests call it after every
+        mutation): partition property, lengths vs table sizes, trash
+        page never handed out."""
+        seen = set(self._free)
+        assert len(seen) == len(self._free), "duplicate pages in free list"
+        for sid, table in self._tables.items():
+            assert self.pages_for(self._lens[sid]) == len(table), (
+                sid, self._lens[sid], table)
+            for p in table:
+                assert p not in seen, f"page {p} aliased"
+                seen.add(p)
+        assert 0 not in seen, "trash page 0 was handed out"
+        assert seen == set(range(1, self.num_pages)), "pages leaked"

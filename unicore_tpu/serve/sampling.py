@@ -1,0 +1,89 @@
+"""Seeded sampling, shared by the serve engine and the legacy
+``examples/lm/generate.py`` path — ONE implementation of
+greedy/temperature/top-k so both stacks emit identical tokens for
+identical (logits, seed, params).
+
+Two entry points for the two calling shapes:
+
+- :func:`sample_token` — scalar sampling params known at trace time
+  (the legacy single-sequence ``generate()`` loop): ``temperature <= 0``
+  is a Python-level branch straight to argmax.
+- :func:`sample_tokens` — per-row ``temperature``/``top_k``/key ARRAYS
+  (the serve engine's jitted decode step, where every batch row is a
+  different request with its own sampling config).  Greedy rows are a
+  ``jnp.where`` select, top-k thresholds are per-row gathers from the
+  sorted logits (``k`` stays a traced value — no per-row recompile).
+
+Determinism contract: requests carry an integer ``seed``; step ``i`` of
+a request samples with ``fold_in(PRNGKey(seed), i)``.  A preempted and
+re-prefilled request resumes at the same fold index, so eviction can
+never change the sampled continuation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def step_key(seed, step):
+    """The per-step sampling key: ``fold_in(PRNGKey(seed), step)``."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+
+def step_keys(seeds, steps):
+    """Vectorized :func:`step_key` for [B] int32 seed/step arrays — the
+    serve engine derives keys INSIDE its jitted steps from these (one
+    host->device transfer of two small int arrays instead of B separate
+    fold_in dispatches per decode iteration)."""
+    return jax.vmap(
+        lambda s, i: jax.random.fold_in(jax.random.PRNGKey(s), i)
+    )(seeds, steps)
+
+
+def _top_k_mask(logits, top_k):
+    """Mask logits below each row's k-th largest value.  ``top_k`` is a
+    per-row int array; 0 (or >= vocab) disables the filter for that row.
+    Traced-``k`` trick: sort descending once, gather the threshold at
+    index k-1 per row."""
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k = jnp.where((top_k <= 0) | (top_k >= vocab), vocab, top_k)
+    thresh = jnp.take_along_axis(
+        sorted_desc, (k - 1)[..., None].astype(jnp.int32), axis=-1
+    )
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample_tokens(logits, keys, temperature, top_k, use_top_k=True):
+    """Batched per-row sampling: ``logits`` [B, V] (fp32 recommended),
+    ``keys`` [B, 2] PRNG keys, ``temperature`` [B] (<= 0 -> greedy),
+    ``top_k`` [B] (0 -> off).  Returns int32 [B].
+
+    ``use_top_k`` is a TRACE-TIME flag: when the caller knows no row in
+    the batch filters (the serve engine checks its live requests), the
+    full-vocab sort is never traced — a top_k=0 row samples identically
+    either way, so flipping variants between steps is sound."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = (_top_k_mask(logits, top_k) if use_top_k else logits) / temp
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, filtered).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def sample_token(logits, key=None, temperature=0.0, top_k=0):
+    """Scalar-parameter sampling for [..., V] logits (the legacy
+    ``generate()`` shape): Python-static greedy branch, shared top-k
+    masking otherwise.  Returns int32 [...]."""
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError("sampling with temperature > 0 requires a key")
+    if top_k and top_k > 0:
+        k = jnp.full(logits.shape[:-1], int(top_k), jnp.int32)
+        logits = _top_k_mask(logits, k)
+    return jax.random.categorical(
+        key, logits / float(temperature), axis=-1
+    ).astype(jnp.int32)

@@ -1,0 +1,192 @@
+"""Continuous batching: admission, interleaving, and eviction policy.
+
+The scheduler is pure host logic over the :class:`PagedKVPool` — no jax
+anywhere — so its central property is testable with randomized traces:
+**no request's tokens are ever lost or duplicated.**  The engine owns
+the device work; the scheduler decides, per step, which sequences
+prefill, which decode, and which get preempted.
+
+Policy (the shape that wins on TPU per the Gemma serving comparison,
+arxiv 2605.25645: keep the decode batch full, amortize prefill between
+decode steps under a token budget):
+
+- Each engine step first ADMITS waiting requests — newest-request-last —
+  while there is a free decode slot, the pool can hold the prompt's
+  pages, and the step's prefill-token budget is not exhausted (the
+  budget caps time-to-first-token jitter for already-running requests;
+  a prompt longer than the whole budget is admitted alone rather than
+  starved).  Then every running sequence takes one decode step.
+- Pool exhaustion when a sequence crosses a page boundary PREEMPTS the
+  most recently admitted running sequence (LIFO victim: it has the
+  least sunk decode work).  Preemption frees the pages and requeues the
+  request at the FRONT of the waiting queue with its generated tokens
+  intact; on re-admission it re-prefills prompt + generated and
+  continues — with seeded sampling keyed by absolute step index, the
+  continuation is token-identical to an uninterrupted run.
+- Termination: EOS, ``max_new_tokens``, or context capacity.
+
+``chaos_rate`` injects random preemptions (seeded) — the scheduler
+property tests force evictions through it instead of hoping a trace
+happens to exhaust the pool.
+"""
+
+import dataclasses
+from collections import deque
+from typing import List, Optional
+
+from .kv_pool import PoolExhausted
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (all sampling state is explicit so a
+    result is reproducible from the request alone)."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    request_id: Optional[str] = None
+
+
+class Sequence:
+    """Scheduler-side state of one request."""
+
+    def __init__(self, sid, req):
+        self.sid = sid
+        self.req = req
+        self.generated: List[int] = []
+        self.evictions = 0
+        self.enqueued_at = None  # host clocks are the engine's job
+        self.first_token_at = None
+        self.finish_reason = None
+
+    def prefix(self):
+        """Tokens whose KV must be live before the next decode step can
+        run (prompt + everything generated so far)."""
+        return list(self.req.prompt) + self.generated
+
+    @property
+    def done(self):
+        return self.finish_reason is not None
+
+
+class Scheduler:
+    def __init__(self, pool, max_batch, prefill_token_budget=512,
+                 chaos_rate=0.0, chaos_rng=None):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.chaos_rate = float(chaos_rate)
+        self.chaos_rng = chaos_rng
+        self.waiting = deque()
+        self.running: List[Sequence] = []
+        self.finished: List[Sequence] = []
+        self.num_evictions = 0
+        self._next_sid = 0
+
+    # -- queue management ---------------------------------------------
+
+    def add(self, req):
+        """Enqueue a request; rejects requests that could NEVER run
+        (a prompt alone outgrowing the pool) instead of livelocking the
+        eviction loop on them later.  Generation beyond the pool is NOT
+        rejected — the engine truncates those with a "capacity" finish,
+        so a sequence's live KV never exceeds what a solo run fits."""
+        need = self.pool.pages_for(len(req.prompt))
+        if need > self.pool.num_usable_pages:
+            raise ValueError(
+                f"prompt needs {need} pages for {len(req.prompt)} "
+                f"tokens; the pool holds {self.pool.num_usable_pages} — "
+                "raise num_pages or shorten the prompt"
+            )
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (prefill always samples "
+                "the first token)"
+            )
+        seq = Sequence(self._next_sid, req)
+        self._next_sid += 1
+        self.waiting.append(seq)
+        return seq
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    # -- one engine step ----------------------------------------------
+
+    def admit(self, bucket=None):
+        """Admit waiting sequences for prefill this step (allocating
+        their pool pages).  ``bucket``: maps a prompt length to the
+        padded prefill length actually traced (budget accounting uses
+        it).  Returns the admitted sequences in admission order."""
+        bucket = bucket or (lambda n: n)
+        admitted = []
+        budget = self.prefill_token_budget
+        while self.waiting and len(self.running) < self.max_batch:
+            seq = self.waiting[0]
+            cost = bucket(len(seq.prefix()))
+            if admitted and cost > budget:
+                break
+            if not self.pool.can_alloc(len(seq.prefix())):
+                break
+            self.waiting.popleft()
+            self.pool.alloc(seq.sid, len(seq.prefix()))
+            self.running.append(seq)
+            admitted.append(seq)
+            budget -= cost
+        return admitted
+
+    def chaos_preempt(self):
+        """Randomly preempt one running sequence (seeded test hook)."""
+        if (self.chaos_rng is not None and self.chaos_rate > 0.0
+                and self.running
+                and self.chaos_rng.random() < self.chaos_rate):
+            victim = self.running[self.chaos_rng.randrange(
+                len(self.running))]
+            self.preempt(victim)
+            return victim
+        return None
+
+    def prepare_decode(self):
+        """Grow every running sequence's pool length by one (the token
+        the next decode step writes), evicting LIFO on exhaustion.
+        Returns the sequences that will decode this step."""
+        for seq in list(self.running):
+            if seq not in self.running:
+                continue  # evicted by an earlier iteration
+            while True:
+                try:
+                    self.pool.extend(seq.sid, 1)
+                    break
+                except PoolExhausted:
+                    victim = self._pick_victim()
+                    self.preempt(victim)
+                    if victim is seq:
+                        break
+        return list(self.running)
+
+    def _pick_victim(self):
+        # LIFO: the most recently admitted loses the least sunk work
+        return self.running[-1]
+
+    def preempt(self, seq):
+        """Free the sequence's pages and requeue it (front: it keeps its
+        age priority).  Its generated tokens stay with it — nothing is
+        lost, and re-prefilling prompt+generated re-creates exactly the
+        KV state the eviction dropped."""
+        self.pool.free(seq.sid)
+        self.running.remove(seq)
+        self.waiting.appendleft(seq)
+        seq.evictions += 1
+        self.num_evictions += 1
+
+    def finish(self, seq, reason):
+        self.pool.free(seq.sid)
+        self.running.remove(seq)
+        seq.finish_reason = reason
+        self.finished.append(seq)
