@@ -38,3 +38,14 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+def pytest_configure(config):
+    # "slow": excluded from the tier-1 gate (pytest -m 'not slow') but
+    # run by the CI workflow's full `pytest tests/` step — for tests
+    # whose value is end-to-end coverage, not per-commit latency (e.g.
+    # the Pass-3 CLI round-trip, which AOT-compiles the train step in
+    # three subprocesses)
+    config.addinivalue_line(
+        "markers", "slow: heavy end-to-end test, excluded from tier-1"
+    )

@@ -449,9 +449,15 @@ def _repo_root():
 def test_repo_source_lint_clean_within_baseline():
     import os
 
+    from unicore_tpu.analysis.cli import DEFAULT_LINT_ROOTS
+
+    # the default file set must cover the tool entry points, not just
+    # the library (ISSUE 4 satellite: examples/ + serve/cli.py + tools/)
+    assert set(DEFAULT_LINT_ROOTS) >= {
+        "unicore_tpu", "unicore_tpu_cli", "examples", "tools", "bench.py"
+    }
     root = _repo_root()
-    roots = [os.path.join(root, d)
-             for d in ("unicore_tpu", "unicore_tpu_cli", "examples")]
+    roots = [os.path.join(root, d) for d in DEFAULT_LINT_ROOTS]
     findings = lint_paths(roots, rel_to=root)
     fps = load_baseline(os.path.join(root, "tools", "lint_baseline.json"))
     new, _ = split_baselined(findings, fps)
@@ -527,6 +533,489 @@ def test_cli_json_report_and_exit_code(tmp_path):
     report = json.loads(out.read_text())
     assert report["counts"]["new"] == 1
     assert report["new_findings"][0]["rule"] == "UL104"
+
+
+# ---------------------------------------------------------------------
+# UL106 where-nan-grad
+# ---------------------------------------------------------------------
+
+def test_where_nan_grad_fires_on_risky_branches(tmp_path):
+    found = _lint_snippet(tmp_path, "model.py", """
+        import jax.numpy as jnp
+        def f(x, n, d):
+            a = jnp.where(x > 0, jnp.sqrt(x), 0.0)
+            b = jnp.where(d != 0, n / d, 0.0)
+            c = jnp.where(x > 0, x ** 0.5, 0.0)
+            return a, b, c
+    """)
+    assert sum(1 for f in found if f.rule == "UL106") == 3
+
+
+def test_where_nan_grad_silent_on_clamped_and_plain(tmp_path):
+    found = _lint_snippet(tmp_path, "model.py", """
+        import jax.numpy as jnp
+        def f(x, n, d, keep, keep_prob, mask):
+            a = jnp.where(x > 0, jnp.sqrt(jnp.maximum(x, 1e-6)), 0.0)
+            b = jnp.where(mask, x, -1e9)              # plain branches
+            c = jnp.where(keep, n / keep_prob, 0.0)   # denom not guarded
+            d2 = jnp.where(x > 0, x * 2.0, x / 4.0)   # constant denom
+            return a, b, c, d2
+    """)
+    assert "UL106" not in rules_of(found)
+
+
+def test_where_nan_grad_ignores_module_alias_overlap(tmp_path):
+    # 'jnp' appearing in both the condition and a denominator is NOT a
+    # shared value, and the documented clamp fix silences the div half
+    found = _lint_snippet(tmp_path, "model.py", """
+        import jax.numpy as jnp
+        def f(self, x, m, w, n, d, eps):
+            a = jnp.where(jnp.all(m), x / jnp.sum(w), 0.0)
+            b = jnp.where(d > eps, n / jnp.maximum(d, eps), 0.0)
+            # attribute ROOTS are not shared values: self.eps vs
+            # self.temperature must not collide on 'self'
+            c = jnp.where(m > self.eps, x / self.temperature, 0.0)
+            # the sanctioned clamp fix silences the pow form too
+            e = jnp.where(x > 0, jnp.maximum(x, eps) ** 0.5, 0.0)
+            return a, b, c, e
+    """)
+    assert "UL106" not in rules_of(found)
+
+
+def test_where_nan_grad_tracks_jnp_import_forms(tmp_path):
+    found = _lint_snippet(tmp_path, "model.py", """
+        from jax import numpy as jn
+        def f(x):
+            return jn.where(x > 0, jn.log(x), 0.0)
+    """)
+    assert "UL106" in rules_of(found)
+
+
+# ---------------------------------------------------------------------
+# Pass 3: HLO parsing primitives (pure text, no compile)
+# ---------------------------------------------------------------------
+
+def test_parse_replica_groups_iota_and_explicit():
+    from unicore_tpu.analysis.hlo_audit import parse_replica_groups
+
+    assert parse_replica_groups("replica_groups=[4,2]<=[8],") == tuple(
+        frozenset(p) for p in [(0, 1), (2, 3), (4, 5), (6, 7)]
+    )
+    # reshape+transpose iota: arange(8).reshape(4,2).T -> strided groups
+    assert parse_replica_groups(
+        "replica_groups=[2,4]<=[4,2]T(1,0),"
+    ) == (frozenset({0, 2, 4, 6}), frozenset({1, 3, 5, 7}))
+    assert parse_replica_groups(
+        "replica_groups={{0,2,4,6},{1,3,5,7}}, use_global"
+    ) == (frozenset({0, 2, 4, 6}), frozenset({1, 3, 5, 7}))
+    assert parse_replica_groups("replica_groups={}", 4) == (
+        frozenset({0, 1, 2, 3}),
+    )
+    assert parse_replica_groups("no groups here") is None
+
+
+_HLO_SNIPPET = """
+  %all-gather = f32[64,64]{1,0} all-gather(f32[32,64]{1,0} %p), \
+channel_id=1, replica_groups=[4,2]<=[8], dimensions={0}, \
+metadata={op_name="jit(step)/fwd/dot_general"}
+  %all-reduce = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %d), \
+channel_id=2, replica_groups=[1,8]<=[8], to_apply=%add
+  %ar-done = f32[8]{0} all-reduce-done(f32[8]{0} %x)
+  %ags = (f32[32,64]{1,0}, f32[64,64]{1,0}) all-gather-start(\
+f32[32,64]{1,0} %p), replica_groups=[4,2]<=[8], dimensions={0}
+  %cp = u32[128]{0} collective-permute(u32[128]{0} %y), \
+source_target_pairs={{0,1}}
+"""
+
+
+def test_extract_collectives_and_stats():
+    from unicore_tpu.analysis.hlo_audit import (
+        collective_stats,
+        extract_collectives,
+    )
+
+    colls = extract_collectives(_HLO_SNIPPET, 8)
+    assert [c.kind for c in colls] == [
+        "all-gather", "all-reduce", "all-gather", "collective-permute"
+    ]
+    ag = colls[0]
+    assert ag.bytes == 64 * 64 * 4 and ag.is_float
+    assert ag.groups == tuple(
+        frozenset(p) for p in [(0, 1), (2, 3), (4, 5), (6, 7)]
+    )
+    assert ag.op_name == "jit(step)/fwd/dot_general"
+    # async -start: the result tuple aliases the operand next to the
+    # output — count the transfer once (largest component), not summed
+    assert colls[2].bytes == 64 * 64 * 4
+    stats = collective_stats(colls)
+    assert stats["collective_bytes"]["all-gather"] == 2 * 64 * 64 * 4
+    assert stats["collective_count"]["collective-permute"] == 1
+    assert not colls[3].is_float  # u32 permute
+
+
+# ---------------------------------------------------------------------
+# Pass 3: UL201 unit fixtures (synthetic collectives over a real mesh)
+# ---------------------------------------------------------------------
+
+def _coll(kind, nbytes, groups, *, is_float=True, shape="f32[x]"):
+    from unicore_tpu.analysis.hlo_audit import Collective
+
+    return Collective(kind=kind, shape=shape, bytes=nbytes,
+                      is_float=is_float,
+                      groups=tuple(frozenset(g) for g in groups),
+                      op_name="test")
+
+
+def test_ul201_unit_fires_and_stays_silent():
+    from unicore_tpu.analysis.hlo_audit import audit_fsdp_collectives
+
+    mesh = _mesh(fsdp=2)  # data=4, fsdp=2: fsdp pairs {0,1},{2,3},...
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    fsdp_pairs = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    healthy = [
+        _coll("all-gather", 16384, fsdp_pairs),
+        _coll("all-reduce", 16384, [(0, 2, 4, 6), (1, 3, 5, 7)]),
+    ]
+    assert audit_fsdp_collectives(mesh, healthy, params,
+                                  context="t") == []
+    # disengaged: only full-mesh all-reduces remain
+    dead = [_coll("all-reduce", 16384, [range(8)])]
+    found = audit_fsdp_collectives(mesh, dead, params, context="t")
+    assert rules_of(found) == {"UL201"}
+    assert "disengaged" in found[0].message
+    # full-remat: weight-sized all-gather spanning the data axis
+    remat = healthy + [_coll("all-gather", 20000, [range(8)])]
+    found = audit_fsdp_collectives(mesh, remat, params, context="t")
+    assert rules_of(found) == {"UL201"}
+    assert "remat" in found[0].message
+    # same gather below weight scale: budget territory, not UL201
+    small = healthy + [_coll("all-gather", 1024, [range(8)])]
+    assert audit_fsdp_collectives(mesh, small, params, context="t") == []
+    # dp mesh: rule does not apply
+    assert audit_fsdp_collectives(_mesh(), dead, params,
+                                  context="t") == []
+
+
+# ---------------------------------------------------------------------
+# Pass 3: UL202/UL203 budget round-trip (unit)
+# ---------------------------------------------------------------------
+
+def test_budget_roundtrip_and_regressions(tmp_path):
+    from unicore_tpu.analysis import hlo_audit
+
+    path = str(tmp_path / "comms.json")
+    fp = "test|fingerprint"
+    stats = {"collective_bytes": {"all-gather": 1000, "all-reduce": 500},
+             "peak_bytes": 10000}
+    hlo_audit.update_budget_entries(path, fp, {"s1": stats})
+    budgets = hlo_audit.load_budgets(path)
+    entry = hlo_audit.budget_entry(budgets, fp, "s1")
+    assert hlo_audit.audit_comms_budget("s1", stats, entry) == []
+    assert hlo_audit.audit_memory_budget("s1", 10000, entry) == []
+    # within tolerance: 4% over passes, >5% fails
+    ok = {"collective_bytes": {"all-gather": 1040, "all-reduce": 500}}
+    assert hlo_audit.audit_comms_budget("s1", ok, entry) == []
+    bad = {"collective_bytes": {"all-gather": 1100, "all-reduce": 500}}
+    found = hlo_audit.audit_comms_budget("s1", bad, entry)
+    assert rules_of(found) == {"UL202"}
+    # a collective kind the budget never saw
+    new_kind = {"collective_bytes": {"all-gather": 1000,
+                                     "all-to-all": 64}}
+    found = hlo_audit.audit_comms_budget("s1", new_kind, entry)
+    assert any("all-to-all" in f.message for f in found)
+    # a zero-byte committed kind must report, not ZeroDivisionError
+    zero_entry = {"collective_bytes": {"all-gather": 0},
+                  "peak_bytes": 10000}
+    found = hlo_audit.audit_comms_budget(
+        "s1", {"collective_bytes": {"all-gather": 64}}, zero_entry
+    )
+    assert rules_of(found) == {"UL202"}
+    # full-surface updates prune scenarios that no longer exist
+    hlo_audit.update_budget_entries(path, fp, {"gone": stats})
+    assert hlo_audit.prune_budget_entries(path, fp, {"s1"}) == ["gone"]
+    assert hlo_audit.budget_entry(
+        hlo_audit.load_budgets(path), fp, "s1") is not None
+    # memory regression + missing budget
+    found = hlo_audit.audit_memory_budget("s1", 11000, entry)
+    assert rules_of(found) == {"UL203"}
+    found = hlo_audit.audit_memory_budget("s1", 11000, None)
+    assert [f.severity for f in found] == ["warning"]
+    # stale fingerprints self-invalidate: entries keyed elsewhere unread
+    assert hlo_audit.budget_entry(budgets, "other|fp", "s1") is None
+    # updating one scenario keeps other fingerprints' sections intact
+    hlo_audit.update_budget_entries(path, "other|fp", {"s2": stats})
+    budgets = hlo_audit.load_budgets(path)
+    assert hlo_audit.budget_entry(budgets, fp, "s1") is not None
+
+
+# ---------------------------------------------------------------------
+# Pass 3: UL204 / UL205 units
+# ---------------------------------------------------------------------
+
+def test_ul204_collective_divergence():
+    from unicore_tpu.analysis.hlo_audit import audit_sequence_match
+
+    a = [_coll("all-gather", 64, [(0, 1)], shape="f32[64]"),
+         _coll("all-reduce", 64, [(0, 1)], shape="f32[64]")]
+    b = list(reversed(a))  # order must NOT matter
+    assert audit_sequence_match("g", [("s1", a), ("s2", b)]) == []
+    c = a + [_coll("all-gather", 64, [(0, 1)], shape="f32[128]")]
+    found = audit_sequence_match("g", [("s1", a), ("s3", c)])
+    assert rules_of(found) == {"UL204"}
+    assert "f32[128]" in found[0].message
+
+
+def test_ul205_serve_recompiles():
+    from unicore_tpu.analysis.hlo_audit import audit_serve_recompiles
+    from unicore_tpu.serve.engine import _pow2_bucket
+
+    declared = (8, 16, 32, 64, 128)
+    assert audit_serve_recompiles(_pow2_bucket, declared, 92) == []
+    # a broken bucket fn: one lowering per prompt length
+    found = audit_serve_recompiles(lambda n: max(n, 8), declared, 92)
+    assert rules_of(found) == {"UL205"}
+    # lengths 1..92 through max(n, 8): 85 distinct lowerings
+    assert "85 distinct" in found[0].message
+
+
+# ---------------------------------------------------------------------
+# Pass 3 integration: the real compiled fsdp2 step (one compile,
+# shared) and the deliberately disengaged spec (ISSUE 4 acceptance)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fsdp2_compiled():
+    import os
+
+    from unicore_tpu.analysis.scenarios import (
+        build_bert_scenario,
+        restore_globals,
+        snapshot_globals,
+    )
+
+    snap = snapshot_globals()
+    try:
+        trainer, samples, _ = build_bert_scenario(
+            os.path.join(_repo_root(), "examples", "bert"),
+            {"fsdp_size": 2}, jax.devices()[:8],
+        )
+        art = trainer.trace_train_step(samples)
+        compiled = art["lowered"].compile()
+        yield trainer, art, compiled
+    finally:
+        restore_globals(snap)
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_ul201_silent_on_healthy_fsdp2(fsdp2_compiled):
+    from unicore_tpu.analysis import hlo_audit
+
+    trainer, art, compiled = fsdp2_compiled
+    found, stats, colls = hlo_audit.audit_compiled(
+        compiled, context="bert/fsdp2", mesh=trainer.mesh,
+        params=art["state"]["params"], num_devices=8,
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+    # the compiled step's collectives are real and byte-counted
+    assert stats["collective_bytes"].get("all-gather", 0) > 0
+    assert stats["peak_bytes"] and stats["peak_bytes"] > 0
+    assert any(c.kind == "all-gather" and c.is_float for c in colls)
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_ul201_fires_on_disengaged_fsdp_spec():
+    """ISSUE 4 acceptance: a deliberately disengaged fsdp spec (state
+    installed replicated on an fsdp mesh) must trip UL201 through the
+    REAL compile path."""
+    import os
+
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis.scenarios import (
+        build_bert_scenario,
+        restore_globals,
+        snapshot_globals,
+    )
+
+    snap = snapshot_globals()
+    try:
+        trainer, samples, _ = build_bert_scenario(
+            os.path.join(_repo_root(), "examples", "bert"),
+            {"fsdp_size": 2}, jax.devices()[:8],
+        )
+        trainer.init_state(samples[0])
+        rep = jax.sharding.NamedSharding(
+            trainer.mesh, jax.sharding.PartitionSpec()
+        )
+        trainer._state_shardings = jax.tree_util.tree_map(
+            lambda _: rep, trainer._state_shardings
+        )
+        trainer.state = jax.device_put(
+            jax.device_get(trainer.state), rep
+        )
+        art = trainer.trace_train_step(samples)
+        compiled = art["lowered"].compile()
+        found, _, _ = hlo_audit.audit_compiled(
+            compiled, context="bert/fsdp2-disengaged",
+            mesh=trainer.mesh, params=art["state"]["params"],
+            num_devices=8,
+        )
+        assert "UL201" in rules_of(found), found
+    finally:
+        restore_globals(snap)
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_real_budget_roundtrip_from_compiled_step(fsdp2_compiled,
+                                                  tmp_path):
+    """--pass3 budget semantics against the real compiled stats: update
+    -> clean; shrink the committed budget -> UL202 + UL203 fail."""
+    import json as _json
+
+    from unicore_tpu.analysis import hlo_audit
+
+    _, _, compiled = fsdp2_compiled
+    _, stats, _ = hlo_audit.audit_compiled(compiled,
+                                           context="bert/fsdp2")
+    path = str(tmp_path / "comms.json")
+    fp = hlo_audit.pass3_fingerprint()
+    hlo_audit.update_budget_entries(path, fp, {"bert/fsdp2": stats})
+    entry = hlo_audit.budget_entry(hlo_audit.load_budgets(path), fp,
+                                   "bert/fsdp2")
+    assert hlo_audit.audit_comms_budget("bert/fsdp2", stats,
+                                        entry) == []
+    assert hlo_audit.audit_memory_budget(
+        "bert/fsdp2", stats["peak_bytes"], entry) == []
+    # an exceeded committed budget must fail
+    data = _json.load(open(path))
+    e = data["budgets"][fp]["bert/fsdp2"]
+    e["collective_bytes"] = {
+        k: int(v * 0.5) for k, v in e["collective_bytes"].items()
+    }
+    e["peak_bytes"] = int(e["peak_bytes"] * 0.5)
+    _json.dump(data, open(path, "w"))
+    entry = hlo_audit.budget_entry(hlo_audit.load_budgets(path), fp,
+                                   "bert/fsdp2")
+    rules = rules_of(
+        hlo_audit.audit_comms_budget("bert/fsdp2", stats, entry)
+        + hlo_audit.audit_memory_budget("bert/fsdp2",
+                                        stats["peak_bytes"], entry)
+    )
+    assert rules == {"UL202", "UL203"}
+
+
+# ---------------------------------------------------------------------
+# Pass 3: the serve engine's jits through Pass 1 + Pass 3 (no device
+# execution)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow  # subprocess/compile latency; CI's full pytest runs it
+def test_serve_jits_trace_clean_through_pass1_and_pass3(tmp_path):
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis.scenarios import build_demo_serve_engine
+    from unicore_tpu.analysis.trace_audit import (
+        audit_donation,
+        audit_jaxpr,
+    )
+
+    engine = build_demo_serve_engine()
+    assert engine.prefill_buckets() == (8, 16, 32, 64, 128)
+    assert hlo_audit.audit_serve_recompiles(
+        engine.bucket_fn, engine.prefill_buckets(), engine.max_context
+    ) == []
+    arts = engine.trace_step_fns(buckets=(8,))
+    assert set(arts) == {"prefill-b8", "decode"}
+    for name, art in arts.items():
+        found = audit_jaxpr(art["jaxpr"], context=f"serve/{name}")
+        found += audit_donation(art["lowered"], context=f"serve/{name}")
+        assert found == [], (name,
+                             "\n".join(f.render() for f in found))
+        compiled = art["lowered"].compile()
+        _, stats, _ = hlo_audit.audit_compiled(
+            compiled, context=f"serve/{name}"
+        )
+        assert stats["peak_bytes"] is None or stats["peak_bytes"] > 0
+    # a sabotaged bucket fn is caught statically before it can compile
+    engine.bucket_fn = lambda n, floor=8: max(n, floor)
+    found = hlo_audit.audit_serve_recompiles(
+        engine.bucket_fn, engine.prefill_buckets(), engine.max_context
+    )
+    assert rules_of(found) == {"UL205"}
+
+
+# ---------------------------------------------------------------------
+# Pass 3 CLI contract: merged JSON schema, exit codes, budget
+# round-trip through the real CLI (dp variant: the fastest compile)
+# ---------------------------------------------------------------------
+
+def _run_cli(args, timeout=420):
+    return subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis", "-q"] + args,
+        cwd=_repo_root(), capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow  # three subprocess AOT compiles (~2 min) — CI's full
+def test_cli_pass3_budget_roundtrip_and_schema(tmp_path):  # pytest runs it
+    budget = str(tmp_path / "comms.json")
+    report = str(tmp_path / "r1.json")
+    base = ["--no-lint", "--no-trace", "--config", "examples/bert",
+            "--cpu-devices", "8", "--pass3", "--pass3-variants", "dp",
+            "--budget-file", budget]
+    # 1) fresh budgets: --update-budgets writes and exits clean
+    proc = _run_cli(base + ["--update-budgets", "--json", report])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    r = json.loads(open(report).read())
+    assert set(r["counts"]) == {"new", "suppressed"}
+    assert r["pass3"]["fingerprint"]
+    scen = {s["scenario"]: s for s in r["pass3"]["scenarios"]}
+    assert "bert/dp" in scen
+    assert scen["bert/dp"]["collective_bytes"]["all-reduce"] > 0
+    assert scen["bert/dp"]["peak_bytes"] > 0
+    # 2) a committed budget exceeded by >5% fails the CLI
+    data = json.loads(open(budget).read())
+    fp = r["pass3"]["fingerprint"]
+    entry = data["budgets"][fp]["bert/dp"]
+    entry["collective_bytes"] = {
+        k: int(v * 0.5) for k, v in entry["collective_bytes"].items()
+    }
+    entry["peak_bytes"] = int(entry["peak_bytes"] * 0.5)
+    open(budget, "w").write(json.dumps(data))
+    proc = _run_cli(base + ["--json", report])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rules = {f["rule"]
+             for f in json.loads(open(report).read())["new_findings"]}
+    assert {"UL202", "UL203"} <= rules
+    # 3) --update-budgets accepts the change and the run passes again
+    proc = _run_cli(base + ["--update-budgets"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow  # subprocess/compile latency; CI's full pytest runs it
+def test_cli_check_baseline_flags_rot(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    rotten = tmp_path / "baseline.json"
+    rotten.write_text(json.dumps({"version": 1, "suppressions": [{
+        "rule": "UL104", "name": "blocking-fetch",
+        "location": "gone.py", "message": "was fixed long ago",
+        "fingerprint": "deadbeefdeadbeef",
+    }]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis", "--no-trace",
+         "-q", "--lint-root", str(clean), "--baseline", str(rotten),
+         "--check-baseline"],
+        cwd=_repo_root(), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1
+    assert "stale" in proc.stdout
+    # without --check-baseline the same rot passes silently
+    proc = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis", "--no-trace",
+         "-q", "--lint-root", str(clean), "--baseline", str(rotten)],
+        cwd=_repo_root(), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0
 
 
 # ---------------------------------------------------------------------

@@ -63,7 +63,7 @@ def _smoke_evoformer():
         return jnp.sum(mod.apply({"params": p}, z, mask) ** 2)
 
     g = jax.jit(jax.grad(f))(params)
-    jax.block_until_ready(g)
+    jax.block_until_ready(g)  # unicore-lint: disable=UL104 (smoke harness syncs by design)
 
 
 def _smoke_evoformer_full():
@@ -88,7 +88,7 @@ def _smoke_evoformer_full():
         return jnp.sum(m2 ** 2) + jnp.sum(z2 ** 2)
 
     g = jax.jit(jax.grad(f))(params)
-    jax.block_until_ready(g)
+    jax.block_until_ready(g)  # unicore-lint: disable=UL104 (smoke harness syncs by design)
 
 
 def _smoke_structure_module():
@@ -107,7 +107,7 @@ def _smoke_structure_module():
         return jnp.sum(pos ** 2) + jnp.sum(s_out ** 2)
 
     g = jax.jit(jax.grad(f))(params)
-    jax.block_until_ready(g)
+    jax.block_until_ready(g)  # unicore-lint: disable=UL104 (smoke harness syncs by design)
 
 
 def main():

@@ -1,7 +1,7 @@
 """unicore-lint: static analysis that catches perf/correctness hazards
-at trace time, before they reach a bench run.
+at trace and compile time, before they reach a bench run.
 
-Two passes (see docs/static_analysis.md):
+Three passes (see docs/static_analysis.md):
 
 - **trace audit** (:mod:`.trace_audit`): trace + lower the REAL jitted
   train step (no execution) and walk the jaxpr/lowered module for
@@ -10,9 +10,18 @@ Two passes (see docs/static_analysis.md):
 - **source lint** (:mod:`.source_lint`): AST rules for the repo's
   idioms — jit-without-donation on train steps, numpy inside jit,
   dataset RNG outside the (seed, epoch, index) derivation, blocking
-  host syncs, and dropout rates the uint8 keep-draw quantizes away.
+  host syncs, dropout rates the uint8 keep-draw quantizes away, and
+  NaN-grad-prone ``where`` branches.
+- **compiled-HLO audit** (:mod:`.hlo_audit`): AOT-compile the real
+  train-step and serve executables (still no execution) and audit the
+  optimized HLO's collectives and memory — fsdp-spec disengagement,
+  collective-bytes and peak-HBM regression against the committed
+  budget file (``tools/comms_baseline.json``), collective parity
+  between must-match program variants, and the serving tier's
+  recompile surface.
 
-Run ``python -m unicore_tpu.analysis --config examples/bert``.
+Run ``python -m unicore_tpu.analysis --config examples/bert``
+(``--pass3 --pass3-serve`` for the compiled audit).
 
 Kept import-light: jax loads only when a trace audit actually runs, so
 ``--cpu-devices`` can still provision the virtual platform first.

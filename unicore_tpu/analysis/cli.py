@@ -1,13 +1,20 @@
 """``python -m unicore_tpu.analysis`` — the unicore-lint entry point.
 
-Runs both passes and reports machine-readable JSON plus human text:
+Runs all passes and reports machine-readable JSON plus human text:
 
-  Pass 1 (trace audit)   --config examples/bert [--cpu-devices 8]
-  Pass 2 (source lint)   on unicore_tpu/ unicore_tpu_cli/ examples/
+  Pass 1 (trace audit)     --config examples/bert [--cpu-devices 8]
+  Pass 2 (source lint)     on unicore_tpu/ unicore_tpu_cli/ examples/
+                           tools/ bench.py
+  Pass 3 (compiled audit)  --pass3 [--pass3-serve]: compile the real
+                           jitted programs and audit the optimized
+                           HLO's collectives + memory against
+                           tools/comms_baseline.json
 
 Exit code 0 when no findings outside the baseline, 1 otherwise.  CI
 pins the baseline (``tools/lint_baseline.json``) so only NEW findings
-fail; ``--write-baseline`` regenerates it after an accepted change.
+fail; ``--write-baseline`` regenerates it after an accepted change and
+``--check-baseline`` fails on baseline rot (suppressions that no longer
+fire).  Pass-3 budgets regenerate via ``--update-budgets``.
 """
 
 import argparse
@@ -15,7 +22,8 @@ import json
 import os
 import sys
 
-DEFAULT_LINT_ROOTS = ("unicore_tpu", "unicore_tpu_cli", "examples")
+DEFAULT_LINT_ROOTS = ("unicore_tpu", "unicore_tpu_cli", "examples",
+                      "tools", "bench.py")
 DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
 
 
@@ -24,7 +32,8 @@ def _anchor_dir():
     it looks like the repo checkout, else the checkout this package was
     imported from (two levels up).  Running the tool from elsewhere must
     not silently lint an empty set and report 'clean'."""
-    if any(os.path.isdir(r) for r in DEFAULT_LINT_ROOTS):
+    if any(os.path.isdir(r) for r in DEFAULT_LINT_ROOTS
+           if not r.endswith(".py")):
         return os.getcwd()
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)
@@ -65,6 +74,44 @@ def build_parser():
     p.add_argument("--write-baseline", action="store_true",
                    help="accept all current findings into the baseline "
                         "file and exit 0")
+    p.add_argument(
+        "--check-baseline", action="store_true",
+        help="fail when the baseline contains suppressions that no "
+             "longer fire (baseline rot); scoped to the rule families "
+             "this invocation runs (trace UL0xx, lint UL1xx, pass-3 "
+             "UL2xx), so a partial run never false-flags entries it "
+             "could not have re-fired",
+    )
+    p.add_argument(
+        "--pass3", action="store_true",
+        help="Pass 3: AOT-compile the --config train step per mesh "
+             "variant and audit the optimized HLO's collectives and "
+             "memory (UL201-UL204) against the budget file",
+    )
+    p.add_argument(
+        "--pass3-serve", action="store_true",
+        help="Pass 3 over the demo ServeEngine: trace/lower every "
+             "prefill bucket + the decode step (Pass-1 rules included) "
+             "and audit recompile surface + budgets (UL205, "
+             "UL202/UL203)",
+    )
+    p.add_argument(
+        "--pass3-variants", default=None, metavar="CSV",
+        help="comma-separated mesh variants for --pass3 (default: "
+             "dp,fsdp2,tp2,tp2_fsdp2)",
+    )
+    p.add_argument(
+        "--budget-file", default=None, metavar="FILE",
+        help="Pass-3 collective/HBM budget file (default: "
+             "tools/comms_baseline.json; entries are keyed by an "
+             "environment fingerprint, so stale entries self-invalidate)",
+    )
+    p.add_argument(
+        "--update-budgets", action="store_true",
+        help="replace the budget entries for the current environment "
+             "fingerprint with this run's measurements before the "
+             "budget rules evaluate (the accepted-change workflow)",
+    )
     p.add_argument("--json", default=None, metavar="FILE",
                    help="also write the report as JSON")
     p.add_argument(
@@ -105,15 +152,23 @@ def main(argv=None):
 
     findings = []
     trace_reports = []
+    pass3_report = None
+    anchor = _anchor_dir()
+
+    needs_jax = (
+        (args.config and not args.no_trace) or args.pass3
+        or args.pass3_serve
+    )
+    if needs_jax and args.cpu_devices:
+        _provision_cpu_devices(args.cpu_devices)
+
+    thresholds = {"pedantic": args.pedantic}
+    if args.big_mib is not None:
+        thresholds["big_bytes"] = args.big_mib << 20
 
     if args.config and not args.no_trace:
-        if args.cpu_devices:
-            _provision_cpu_devices(args.cpu_devices)
         from unicore_tpu.analysis.scenarios import audit_bert_config
 
-        thresholds = {"pedantic": args.pedantic}
-        if args.big_mib is not None:
-            thresholds["big_bytes"] = args.big_mib << 20
         got, trace_reports = audit_bert_config(
             args.config, thresholds=thresholds, log=log,
             n_devices=args.cpu_devices or None,
@@ -123,13 +178,62 @@ def main(argv=None):
             if "skipped" in r:
                 log(f"variant {r['variant']}: SKIPPED ({r['skipped']})")
 
-    anchor = _anchor_dir()
+    if args.pass3 or args.pass3_serve:
+        from unicore_tpu.analysis import hlo_audit
+
+        budget_path = args.budget_file or os.path.join(
+            anchor, hlo_audit.DEFAULT_BUDGET_FILE
+        )
+        pass3_report = {"budget_file": budget_path, "scenarios": []}
+        if args.pass3:
+            if not args.config:
+                print("unicore-lint: error: --pass3 needs --config",
+                      file=sys.stderr)
+                return 2
+            from unicore_tpu.analysis.scenarios import (
+                audit_bert_config_pass3,
+            )
+
+            variants = (args.pass3_variants.split(",")
+                        if args.pass3_variants else None)
+            got, rep = audit_bert_config_pass3(
+                args.config, variants=variants,
+                n_devices=args.cpu_devices or None,
+                budget_path=budget_path,
+                update_budgets=args.update_budgets, log=log,
+            )
+            findings.extend(got)
+            pass3_report["fingerprint"] = rep["fingerprint"]
+            pass3_report["scenarios"].extend(rep["scenarios"])
+        if args.pass3_serve:
+            from unicore_tpu.analysis.scenarios import audit_serve_demo
+
+            got, rep = audit_serve_demo(
+                budget_path=budget_path,
+                update_budgets=args.update_budgets,
+                thresholds=thresholds, log=log,
+            )
+            findings.extend(got)
+            pass3_report.setdefault("fingerprint", rep["fingerprint"])
+            pass3_report["scenarios"].extend(rep["scenarios"])
+        if (args.update_budgets and args.pass3 and args.pass3_serve
+                and not args.pass3_variants
+                and pass3_report.get("fingerprint")):
+            # full measurement surface: scenarios absent from this run
+            # no longer exist — drop their stale budget entries
+            pruned = hlo_audit.prune_budget_entries(
+                budget_path, pass3_report["fingerprint"],
+                keep={s["scenario"] for s in pass3_report["scenarios"]
+                      if "skipped" not in s},
+            )
+            for s in pruned:
+                log(f"pass3: pruned stale budget entry {s}")
     if not args.no_lint:
         from unicore_tpu.analysis.source_lint import lint_paths
 
         roots = args.lint_root or [
             os.path.join(anchor, r) for r in DEFAULT_LINT_ROOTS
-            if os.path.isdir(os.path.join(anchor, r))
+            if os.path.exists(os.path.join(anchor, r))
         ]
         if not roots:
             print(
@@ -146,6 +250,7 @@ def main(argv=None):
         render_report,
         report_json,
         split_baselined,
+        stale_baseline_entries,
         write_baseline,
     )
 
@@ -159,16 +264,47 @@ def main(argv=None):
     fps = set() if args.no_baseline else load_baseline(baseline_path)
     new, suppressed = split_baselined(findings, fps)
 
+    stale = []
+    if args.check_baseline and not args.no_baseline:
+        # only the rule families THIS invocation executed can prove an
+        # entry stale: a lint-only run must not flag trace or pass-3
+        # suppressions as rot (and vice versa) — otherwise accepting a
+        # pass-3 finding into the baseline would deadlock against a CI
+        # step that runs passes 1-2 only
+        ran = set()
+        if args.config and not args.no_trace:
+            ran.add("UL0")
+        if not args.no_lint:
+            ran.add("UL1")
+        if args.pass3 or args.pass3_serve:
+            ran.add("UL2")
+        stale = [
+            e for e in stale_baseline_entries(baseline_path, findings)
+            if str(e.get("rule", ""))[:3] in ran
+        ]
+        for e in stale:
+            print(
+                f"{baseline_path}: stale suppression {e['fingerprint']} "
+                f"({e.get('rule', '?')} at {e.get('location', '?')}) — "
+                f"the finding no longer fires; remove it or rerun "
+                f"--write-baseline",
+            )
+
+    extra = {"trace": trace_reports}
+    if pass3_report is not None:
+        extra["pass3"] = pass3_report
+    if stale:
+        extra["stale_baseline"] = stale
     if args.json:
         with open(args.json, "w") as fh:
-            json.dump(
-                report_json(new, suppressed,
-                            extra={"trace": trace_reports}),
-                fh, indent=2,
-            )
+            json.dump(report_json(new, suppressed, extra=extra),
+                      fh, indent=2)
             fh.write("\n")
     print(render_report(new, suppressed))
-    return 1 if new else 0
+    if stale:
+        print(f"unicore-lint: {len(stale)} stale baseline "
+              f"suppression(s) (baseline rot)")
+    return 1 if (new or stale) else 0
 
 
 if __name__ == "__main__":

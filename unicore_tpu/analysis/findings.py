@@ -89,6 +89,22 @@ def write_baseline(path, findings):
         fh.write("\n")
 
 
+def stale_baseline_entries(path, findings):
+    """Baseline suppressions whose fingerprint matches NO current
+    finding — baseline rot: the hazard was fixed (or its message
+    drifted) but the acceptance entry lives on, able to silently eat a
+    future reintroduction.  Call only with the findings of a FULL run;
+    a partial run legitimately misses findings."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return []
+    live = {f.fingerprint for f in findings}
+    return [e for e in data.get("suppressions", [])
+            if e.get("fingerprint") not in live]
+
+
 def split_baselined(findings, baseline_fps):
     """(new, suppressed) partition against a fingerprint set."""
     new, suppressed = [], []

@@ -1,0 +1,543 @@
+"""Pass 3: compiled-HLO collective & memory audit.
+
+Passes 1/2 see the program *before* XLA: the jaxpr and the source.  The
+hazards that bit at pod scale live *after* — in the optimized HLO the
+SPMD partitioner emits: an fsdp spec that silently disengages (weights
+update replicated, gradients all-reduce unsharded), collective-bytes
+creep, peak-HBM creep, and a serving tier whose prompt bucketing quietly
+compiles one executable per prompt length.  This pass AOT-compiles the
+REAL jitted programs (``Trainer.trace_train_step(...)["lowered"]
+.compile()`` and ``ServeEngine.trace_step_fns``) on the spoofed
+8-device CPU mesh and walks the compiled module text — the collectives
+it sees are the ones a v5e pod would run, because GSPMD partitions
+before backend-specific lowering.
+
+Rules (UL2xx family, locations ``hlo:<scenario>``):
+
+- UL201 fsdp-disengaged: on a mesh whose fsdp axis is real, no
+  collective's replica groups align with the fsdp axis — the sharded
+  weight-update pattern (shard gathers / partial reductions within the
+  fsdp groups) is absent and full weight-sized tensors move over
+  full-mesh collectives instead.  Also fires on a weight-sized
+  all-gather whose groups span the *data* axis: data replicas
+  exchanging full tensors is the involuntary-full-remat signature.
+- UL202 comms-budget: per-scenario collective bytes regressed by more
+  than ``tolerance`` against the committed budget file
+  (``tools/comms_baseline.json``), or a collective kind appeared that
+  the budget has never seen.
+- UL203 hbm-budget: the compiled step's estimated peak bytes (the same
+  ``memory_analysis()`` arithmetic the Trainer's pre-flight check uses)
+  regressed by more than ``tolerance`` against the same budget file.
+- UL204 collective-divergence: two program variants declared to match
+  (the grad-accumulation scan body vs the fused single-micro-batch
+  path of the same mesh) compile to different collective multisets.
+- UL205 serve-recompile: the serving bucket function produces more
+  distinct prefill lowerings than the engine's declared bucket set —
+  the recompile-per-prompt-length explosion.
+
+Budgets are keyed by an environment fingerprint (device kind, device
+count, jax version — the same self-invalidation idiom as the kernel
+tune cache): entries from another environment are ignored, never
+misapplied.  Byte counts are static-structure counts — a collective
+inside a ``while`` body is counted once, not per iteration — which is
+exactly what a regression budget needs (the loop structure is part of
+the program being pinned).
+"""
+
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from unicore_tpu.analysis.findings import Finding
+
+BUDGET_VERSION = 1
+DEFAULT_BUDGET_FILE = os.path.join("tools", "comms_baseline.json")
+DEFAULT_TOLERANCE = 0.05       # UL202/UL203: >5% over budget fails
+
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+# "%name = <shape> <kind>(" — also matches async "-start" forms; the
+# paired "-done" op repeats the buffer and must not double-count
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\(",
+)
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(?P<dims>[0-9,]+)\]<=\[(?P<reshape>[0-9,]+)\]"
+    r"(?:T\((?P<perm>[0-9,]+)\))?"
+)
+_GROUPS_EXPLICIT_RE = re.compile(
+    r"replica_groups=\{(?P<body>(?:\{[0-9,]*\},?)*)\}"
+)
+_OP_NAME_RE = re.compile(r'op_name="(?P<name>[^"]*)"')
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_FLOAT_DTYPES = {"f64", "f32", "f16", "bf16", "f8e4m3fn", "f8e5m2"}
+
+
+@dataclass(frozen=True)
+class Collective:
+    kind: str                 # "all-gather"
+    shape: str                # "f32[64,64]" (tuple shapes joined by "+")
+    bytes: int                # result bytes (tuple: summed)
+    is_float: bool            # any float component
+    groups: Optional[Tuple]   # tuple of frozensets of device ids
+    op_name: str              # jax op_name metadata ("" when absent)
+
+
+def _shape_bytes(shape_text, *, largest_only=False):
+    """(bytes, shape_str, is_float) for one HLO result type, which may
+    be a tuple like ``(f32[64]{0}, u32[]{})``.  ``largest_only`` counts
+    only the biggest component — for async ``-start`` forms, whose
+    result tuple aliases the operand next to the real output (summing
+    would double-count the transfer)."""
+    sizes, parts, is_float = [], [], False
+    for m in _SHAPE_RE.finditer(shape_text):
+        dtype = m.group("dtype")
+        if dtype not in _DTYPE_BYTES:
+            continue
+        dims = [int(d) for d in m.group("dims").split(",") if d]
+        n = 1
+        for d in dims:
+            n *= d
+        sizes.append(n * _DTYPE_BYTES[dtype])
+        parts.append(f"{dtype}[{','.join(str(d) for d in dims)}]")
+        is_float = is_float or dtype in _FLOAT_DTYPES
+    total = (max(sizes) if largest_only else sum(sizes)) if sizes else 0
+    return total, "+".join(parts), is_float
+
+
+def parse_replica_groups(line, num_devices=None):
+    """Decode ``replica_groups=`` from an HLO line into a tuple of
+    frozensets of device ids; None when the line carries none.
+
+    Handles both serializations: explicit ``{{0,1},{2,3}}`` and iota
+    ``[G,S]<=[dims]T(perm)`` (ids = arange.reshape(dims).transpose(perm)
+    .flatten(), regrouped into G groups of S)."""
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        g, s = (int(x) for x in m.group("dims").split(","))
+        dims = [int(x) for x in m.group("reshape").split(",")]
+        n = 1
+        for d in dims:
+            n *= d
+        ids = list(range(n))
+        if m.group("perm"):
+            import numpy as np
+
+            perm = [int(x) for x in m.group("perm").split(",")]
+            ids = list(np.arange(n).reshape(dims).transpose(perm).ravel())
+        return tuple(
+            frozenset(int(i) for i in ids[k * s:(k + 1) * s])
+            for k in range(g)
+        )
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        body = m.group("body").strip()
+        if not body:
+            # empty groups: one group of every participant
+            if num_devices:
+                return (frozenset(range(num_devices)),)
+            return None
+        return tuple(
+            frozenset(int(x) for x in grp.split(",") if x)
+            for grp in re.findall(r"\{([0-9,]*)\}", body)
+        )
+    return None
+
+
+def extract_collectives(hlo_text, num_devices=None) -> List[Collective]:
+    """Every collective op in a compiled module's text dump."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or f"{m.group('kind')}-done(" in line:
+            continue
+        nbytes, shape, is_float = _shape_bytes(
+            m.group("shape"),
+            largest_only=f"{m.group('kind')}-start(" in line,
+        )
+        name = _OP_NAME_RE.search(line)
+        out.append(Collective(
+            kind=m.group("kind"), shape=shape, bytes=nbytes,
+            is_float=is_float,
+            groups=parse_replica_groups(line, num_devices),
+            op_name=name.group("name") if name else "",
+        ))
+    return out
+
+
+def collective_stats(collectives):
+    """{"collective_bytes": {kind: total}, "collective_count": {...}}"""
+    by_bytes: Dict[str, int] = {}
+    by_count: Dict[str, int] = {}
+    for c in collectives:
+        by_bytes[c.kind] = by_bytes.get(c.kind, 0) + c.bytes
+        by_count[c.kind] = by_count.get(c.kind, 0) + 1
+    return {"collective_bytes": by_bytes, "collective_count": by_count}
+
+
+def estimate_peak_bytes(compiled):
+    """Peak-HBM estimate of one compiled executable — the same
+    arithmetic as the Trainer's pre-flight check (trainer.py
+    ``estimate_peak_bytes``); None when the backend lacks
+    memory_analysis.  The import stays OUTSIDE the except: a broken
+    trainer helper must fail loudly, not silently disable the UL203
+    gate (which treats a None peak as 'nothing provable')."""
+    from unicore_tpu.trainer import estimate_peak_bytes as _est
+
+    try:
+        ma = compiled.memory_analysis()
+        return _est(ma)
+    except Exception:  # backend without memory introspection
+        return None
+
+
+# ---------------------------------------------------------------------
+# UL201 — fsdp engagement / full-remat gathers
+# ---------------------------------------------------------------------
+
+def _device_coords(mesh):
+    """{device_id: {axis_name: coordinate}} over the mesh array."""
+    import numpy as np
+
+    coords = {}
+    for idx in np.ndindex(*mesh.devices.shape):
+        dev = mesh.devices[idx]
+        coords[int(dev.id)] = dict(zip(mesh.axis_names, idx))
+    return coords
+
+
+def _group_axis_span(group, coords, axis):
+    """How many distinct ``axis`` coordinates a replica group covers."""
+    return len({coords[d][axis] for d in group if d in coords})
+
+
+def _varies_only_along(group, coords, axes):
+    """True when every member of ``group`` agrees on every mesh axis
+    outside ``axes`` (the group is a slab of the given axes)."""
+    fixed = None
+    for d in group:
+        c = coords.get(d)
+        if c is None:
+            return False
+        key = tuple(v for a, v in c.items() if a not in axes)
+        if fixed is None:
+            fixed = key
+        elif key != fixed:
+            return False
+    return True
+
+
+def audit_fsdp_collectives(mesh, collectives, params, *, context,
+                           model_axes=("fsdp", "tensor")):
+    """UL201 over one compiled program's collectives.
+
+    Two signatures of a disengaged/contradicted spec:
+
+    - **dead fsdp axis**: the mesh declares fsdp > 1 but no float
+      collective's replica groups align with it (vary along the model
+      axes only, spanning >= 2 fsdp coordinates).  A healthy ZeRO
+      program gathers weight shards and partially reduces gradients
+      within exactly those groups; their absence means the state
+      replicated and every gradient all-reduces unsharded.
+    - **data-spanning weight gather**: an all-gather of a float buffer
+      at least as large as the largest parameter leaf whose groups span
+      >= 2 data coordinates — data-parallel replicas hold identical
+      state by construction, so a weight-sized exchange between them is
+      resharding (the involuntary-full-remat GSPMD warning made a
+      finding)."""
+    import numpy as np
+
+    import jax
+
+    extent = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if extent.get("fsdp", 1) <= 1:
+        return []
+    coords = _device_coords(mesh)
+    location = f"hlo:{context}"
+    findings = []
+
+    engaged = False
+    for c in collectives:
+        if not (c.is_float and c.groups):
+            continue
+        if c.kind not in ("all-gather", "reduce-scatter", "all-reduce"):
+            continue
+        if all(
+            _varies_only_along(g, coords, model_axes)
+            and _group_axis_span(g, coords, "fsdp") >= 2
+            for g in c.groups
+        ):
+            engaged = True
+            break
+    if not engaged:
+        evidence = max(
+            (c for c in collectives if c.is_float
+             and c.kind in ("all-reduce", "all-gather")),
+            key=lambda c: c.bytes, default=None,
+        )
+        detail = (
+            f"; largest full-size collective: {evidence.kind} "
+            f"{evidence.shape} ({evidence.bytes / 1024:.0f} KiB)"
+            if evidence else ""
+        )
+        findings.append(Finding(
+            "UL201", "fsdp-disengaged", "error", location,
+            f"mesh declares an fsdp axis of size {extent['fsdp']} but no "
+            f"collective in the compiled step aligns with it — the fsdp "
+            f"spec disengaged: weights update replicated and gradients "
+            f"all-reduce unsharded across the whole mesh{detail}",
+        ))
+
+    leaf_bytes = [
+        int(np.prod(l.shape, dtype=np.int64)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(params)
+        if hasattr(l, "shape") and l.shape
+    ]
+    weight_scale = max(leaf_bytes, default=0)
+    for c in collectives:
+        if (c.kind == "all-gather" and c.is_float and c.groups
+                and weight_scale and c.bytes >= weight_scale
+                and any(_group_axis_span(g, coords, "data") >= 2
+                        for g in c.groups)):
+            findings.append(Finding(
+                "UL201", "fsdp-disengaged", "error", location,
+                f"weight-sized all-gather {c.shape} "
+                f"({c.bytes / 1024:.0f} KiB) spans the data axis "
+                f"(op {c.op_name or '?'}) — data replicas hold identical "
+                f"state, so this is GSPMD resharding a tensor it could "
+                f"not keep sharded (involuntary full rematerialization)",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# UL202 / UL203 — budgets
+# ---------------------------------------------------------------------
+
+def pass3_fingerprint():
+    """Budget-file key namespace: everything that can change what the
+    compiler emits (mirrors the tune cache's env_fingerprint idiom)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return "|".join((
+        f"fmt{BUDGET_VERSION}",
+        getattr(dev, "device_kind", "unknown"),
+        f"n{jax.device_count()}",
+        f"jax{jax.__version__}",
+    ))
+
+
+def load_budgets(path):
+    """Full budget file ({} when absent/unreadable — a missing file is
+    'no budgets yet', not an error)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def write_budgets(path, data):
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def update_budget_entries(path, fingerprint, scenario_stats):
+    """Replace the ``fingerprint`` section's entries for the measured
+    scenarios; other fingerprints' sections are kept verbatim (they
+    self-invalidate by never being read in this environment)."""
+    data = load_budgets(path)
+    data.setdefault("version", BUDGET_VERSION)
+    section = data.setdefault("budgets", {}).setdefault(fingerprint, {})
+    for scenario, stats in scenario_stats.items():
+        section[scenario] = {
+            "collective_bytes": dict(stats.get("collective_bytes", {})),
+            "peak_bytes": stats.get("peak_bytes"),
+        }
+    write_budgets(path, data)
+    return data
+
+
+def prune_budget_entries(path, fingerprint, keep):
+    """Drop the ``fingerprint`` section's entries for scenarios not in
+    ``keep`` — budget rot (a renamed prefill bucket, a removed mesh
+    variant) must not live on as dead weight in a reviewed file.  Only
+    call after a FULL measurement (every scenario audited): a partial
+    run cannot prove an unmeasured scenario gone."""
+    data = load_budgets(path)
+    section = data.get("budgets", {}).get(fingerprint)
+    if not section:
+        return []
+    stale = sorted(s for s in section if s not in keep)
+    for s in stale:
+        del section[s]
+    if stale:
+        write_budgets(path, data)
+    return stale
+
+
+def budget_entry(budgets, fingerprint, scenario):
+    return (budgets.get("budgets", {}).get(fingerprint, {})
+            .get(scenario))
+
+
+def audit_comms_budget(scenario, stats, entry, *, tolerance=DEFAULT_TOLERANCE):
+    """UL202: collective bytes vs the committed budget for one scenario."""
+    location = f"hlo:{scenario}"
+    actual = stats.get("collective_bytes", {})
+    if entry is None:
+        if not actual:
+            return []  # nothing to budget (e.g. single-device serve jits)
+        return [Finding(
+            "UL202", "comms-budget", "warning", location,
+            "no committed collective-bytes budget for this scenario "
+            "under the current environment fingerprint — run "
+            "--update-budgets and commit tools/comms_baseline.json",
+        )]
+    findings = []
+    budget = entry.get("collective_bytes", {})
+    for kind, got in sorted(actual.items()):
+        want = budget.get(kind)
+        if want is None:
+            if got:
+                findings.append(Finding(
+                    "UL202", "comms-budget", "error", location,
+                    f"collective kind '{kind}' ({got} bytes) is not in "
+                    f"the committed budget — a new collective appeared "
+                    f"in the compiled step (accept with --update-budgets)",
+                ))
+        elif got > want * (1.0 + tolerance):
+            pct = (f"+{(got / want - 1.0) * 100:.1f}%" if want
+                   else "budgeted at zero")
+            findings.append(Finding(
+                "UL202", "comms-budget", "error", location,
+                f"'{kind}' bytes regressed: {got} vs budget {want} "
+                f"({pct}, tolerance {tolerance * 100:.0f}%) — the step "
+                f"moves more data over the interconnect than the "
+                f"committed baseline",
+            ))
+    return findings
+
+
+def audit_memory_budget(scenario, peak_bytes, entry, *,
+                        tolerance=DEFAULT_TOLERANCE):
+    """UL203: compiled peak-HBM estimate vs the committed budget."""
+    location = f"hlo:{scenario}"
+    if peak_bytes is None:
+        return []  # backend without memory_analysis: nothing provable
+    if entry is None or entry.get("peak_bytes") is None:
+        return [Finding(
+            "UL203", "hbm-budget", "warning", location,
+            "no committed peak-HBM budget for this scenario under the "
+            "current environment fingerprint — run --update-budgets "
+            "and commit tools/comms_baseline.json",
+        )]
+    want = entry["peak_bytes"]
+    if want and peak_bytes > want * (1.0 + tolerance):
+        return [Finding(
+            "UL203", "hbm-budget", "error", location,
+            f"estimated peak bytes regressed: {peak_bytes} vs budget "
+            f"{want} (+{(peak_bytes / want - 1.0) * 100:.1f}%, tolerance "
+            f"{tolerance * 100:.0f}%) — peak-HBM creep that only shows "
+            f"at scale starts here",
+        )]
+    return []
+
+
+# ---------------------------------------------------------------------
+# UL204 — collective-sequence divergence between must-match variants
+# ---------------------------------------------------------------------
+
+def audit_sequence_match(group_name, members, *, max_listed=4):
+    """UL204 over one match group: ``members`` is [(scenario,
+    [Collective, ...]), ...]; every member must compile to the same
+    multiset of (kind, shape) collectives.  Multisets, not ordered
+    sequences: XLA's scheduling order is not semantically meaningful,
+    the collective *structure* is."""
+    if len(members) < 2:
+        return []
+    base_name, base = members[0]
+    base_set = Counter((c.kind, c.shape) for c in base)
+    findings = []
+    for name, colls in members[1:]:
+        got = Counter((c.kind, c.shape) for c in colls)
+        if got == base_set:
+            continue
+        missing = base_set - got
+        extra = got - base_set
+        parts = []
+        if missing:
+            parts.append("missing " + ", ".join(
+                f"{k} {s}" for k, s in list(missing)[:max_listed]))
+        if extra:
+            parts.append("extra " + ", ".join(
+                f"{k} {s}" for k, s in list(extra)[:max_listed]))
+        findings.append(Finding(
+            "UL204", "collective-divergence", "error",
+            f"hlo:{name}",
+            f"collective multiset diverges from '{base_name}' in match "
+            f"group '{group_name}': {'; '.join(parts)} — variants that "
+            f"must compile to the same communication pattern no longer do",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# UL205 — serve recompile explosion
+# ---------------------------------------------------------------------
+
+def audit_serve_recompiles(bucket_fn, declared, max_context, *,
+                           context="serve"):
+    """UL205: simulate every admissible prompt length through the
+    engine's bucket function; each distinct bucket is one prefill
+    executable, and every bucket outside the declared set is a
+    recompile the engine never planned for."""
+    declared = set(declared)
+    seen = set()
+    for n in range(1, max_context + 1):
+        seen.add(int(bucket_fn(n)))
+    extra = sorted(b for b in seen if b not in declared)
+    if not extra:
+        return []
+    shown = ", ".join(str(b) for b in extra[:8])
+    more = f" (+{len(extra) - 8} more)" if len(extra) > 8 else ""
+    return [Finding(
+        "UL205", "serve-recompile", "error", f"hlo:{context}",
+        f"prompt bucketing produces {len(seen)} distinct prefill "
+        f"lowerings but the engine declares {len(declared)} buckets; "
+        f"undeclared buckets: {shown}{more} — each is a fresh XLA "
+        f"compile at serve time (the recompile-per-prompt-length "
+        f"explosion)",
+    )]
+
+
+def audit_compiled(compiled, *, context, mesh=None, params=None,
+                   num_devices=None):
+    """Convenience wrapper: extract collectives + stats from one
+    compiled executable, run UL201 when a mesh is given.  Returns
+    (findings, stats, collectives)."""
+    colls = extract_collectives(compiled.as_text(), num_devices)
+    stats = collective_stats(colls)
+    stats["peak_bytes"] = estimate_peak_bytes(compiled)
+    findings = []
+    if mesh is not None and params is not None:
+        findings = audit_fsdp_collectives(
+            mesh, colls, params, context=context
+        )
+    return findings, stats, colls

@@ -31,6 +31,24 @@ MESH_VARIANTS = (
     ("tp2_fsdp2", {"tensor_parallel_size": 2, "fsdp_size": 2}, 4),
 )
 
+# Pass 3 compiles (not just traces) each variant, so the set is the
+# bench-relevant subset: seq2's ring shard_map collectives are pinned by
+# tests/test_parallel.py already and its compile is the slowest.
+PASS3_VARIANTS = ("dp", "fsdp2", "tp2", "tp2_fsdp2")
+
+# UL204 match pairs: (group name, [(scenario suffix, overrides,
+# micro-batches to feed), ...]) — members must compile to the same
+# collective multiset.  The flagship pair pins the hand-written
+# n_micro==1 fast path in Trainer._make_train_step against the scan
+# path: both run the identical per-micro-batch program, so a divergence
+# means one of them lost a constraint.
+PASS3_MATCH_GROUPS = (
+    ("bert/fsdp2-accum", (
+        ("fsdp2", {"fsdp_size": 2}, 2),
+        ("fsdp2-uf1", {"fsdp_size": 2, "update_freq": [1]}, 1),
+    )),
+)
+
 
 def base_args(**overrides):
     args = Namespace(
@@ -171,6 +189,220 @@ def restore_globals(snapshot):
     parallel.disable_sequence_parallel()
     parallel.disable_tensor_parallel()
     dist_utils.reset_mesh(snapshot)
+
+
+def compile_variant(example_dir, overrides, devices, *,
+                    n_micro=None):
+    """Build one mesh variant, trace its train step, and AOT-compile the
+    lowered module (still no device execution: ``compile()`` produces
+    the executable, nothing dispatches it).  Returns (trainer, art,
+    compiled)."""
+    trainer, samples, _ = build_bert_scenario(example_dir, overrides,
+                                              devices)
+    art = trainer.trace_train_step(samples[:n_micro] if n_micro
+                                   else samples)
+    return trainer, art, art["lowered"].compile()
+
+
+def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
+                            budget_path=None, update_budgets=False,
+                            tolerance=None, log=None):
+    """Pass-3 compiled-HLO audit over the bert config's mesh variants.
+
+    Per variant: compile the real train step, extract its collectives,
+    run UL201 (fsdp engagement), and check UL202/UL203 against the
+    committed budget file.  Match groups (``PASS3_MATCH_GROUPS``) then
+    compile their extra members and run UL204.  With ``update_budgets``
+    the measured stats replace the budget entries for the current
+    environment fingerprint BEFORE the budget rules evaluate, so an
+    accepted change leaves the run clean.
+
+    Returns (findings, report) where report carries the fingerprint and
+    per-scenario stats for the JSON report.
+    """
+    import jax
+
+    from unicore_tpu.analysis import hlo_audit
+
+    avail = jax.devices()
+    if n_devices is None:
+        n_devices = min(8, len(avail))
+    devices = avail[:n_devices]
+    tol = hlo_audit.DEFAULT_TOLERANCE if tolerance is None else tolerance
+
+    wanted = tuple(variants or PASS3_VARIANTS)
+    variant_map = {name: (ov, mind) for name, ov, mind in MESH_VARIANTS}
+    unknown = [v for v in wanted if v not in variant_map]
+    if unknown:
+        raise ValueError(
+            f"unknown pass-3 variant(s) {unknown}; pick from "
+            f"{sorted(variant_map)}"
+        )
+    findings = []
+    scenario_stats = {}
+    colls_by_scenario = {}
+    snap = snapshot_globals()
+    scenarios_report = []
+    try:
+        for name in wanted:
+            overrides, min_dev = variant_map[name]
+            if len(devices) < min_dev or len(devices) % max(min_dev, 1):
+                scenarios_report.append({
+                    "scenario": f"bert/{name}",
+                    "skipped": f"needs {min_dev} devices, have "
+                               f"{len(devices)}",
+                })
+                continue
+            ctx = f"bert/{name}"
+            if log:
+                log(f"pass3: compiling {ctx}")
+            trainer, art, compiled = compile_variant(
+                example_dir, overrides, devices
+            )
+            got, stats, colls = hlo_audit.audit_compiled(
+                compiled, context=ctx, mesh=trainer.mesh,
+                params=art["state"]["params"], num_devices=len(devices),
+            )
+            findings.extend(got)
+            scenario_stats[ctx] = stats
+            colls_by_scenario[ctx] = colls
+            scenarios_report.append({"scenario": ctx, **stats})
+
+        for group_name, members in PASS3_MATCH_GROUPS:
+            # a restricted --pass3-variants run only pays for the match
+            # groups it asked for: skip groups none of whose members'
+            # base variants were requested
+            if not any(suffix in wanted for suffix, _, _ in members):
+                continue
+            matched = []
+            for suffix, overrides, n_micro in members:
+                ctx = f"bert/{suffix}"
+                if ctx in colls_by_scenario:
+                    matched.append((ctx, colls_by_scenario[ctx]))
+                    continue
+                min_dev = max(
+                    overrides.get("fsdp_size", 1)
+                    * overrides.get("tensor_parallel_size", 1), 1
+                )
+                if len(devices) < min_dev:
+                    continue
+                if log:
+                    log(f"pass3: compiling {ctx} (match group "
+                        f"'{group_name}')")
+                trainer, art, compiled = compile_variant(
+                    example_dir, overrides, devices, n_micro=n_micro,
+                )
+                colls = hlo_audit.extract_collectives(
+                    compiled.as_text(), len(devices)
+                )
+                matched.append((ctx, colls))
+            findings.extend(
+                hlo_audit.audit_sequence_match(group_name, matched)
+            )
+    finally:
+        restore_globals(snap)
+
+    fp = None
+    if budget_path is not None:
+        fp = hlo_audit.pass3_fingerprint()
+        if update_budgets and scenario_stats:
+            hlo_audit.update_budget_entries(budget_path, fp,
+                                            scenario_stats)
+            if log:
+                log(f"pass3: wrote {len(scenario_stats)} budget "
+                    f"entr(ies) to {budget_path}")
+        budgets = hlo_audit.load_budgets(budget_path)
+        for ctx, stats in scenario_stats.items():
+            entry = hlo_audit.budget_entry(budgets, fp, ctx)
+            findings.extend(hlo_audit.audit_comms_budget(
+                ctx, stats, entry, tolerance=tol
+            ))
+            findings.extend(hlo_audit.audit_memory_budget(
+                ctx, stats.get("peak_bytes"), entry, tolerance=tol
+            ))
+    report = {"fingerprint": fp, "scenarios": scenarios_report}
+    return findings, report
+
+
+def build_demo_serve_engine(seed=1):
+    """The ``unicore-serve --demo`` engine at the CI smoke settings: a
+    pool small enough that paging is real, every prefill bucket
+    reachable."""
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.engine import ServeEngine
+
+    model, params = _demo_model(seed)
+    return ServeEngine(model, params, num_pages=24, page_size=4,
+                       max_batch=4)
+
+
+def audit_serve_demo(*, budget_path=None, update_budgets=False,
+                     tolerance=None, thresholds=None, log=None,
+                     engine=None):
+    """Pass 1 + Pass 3 over the demo ServeEngine's prefill/decode jits.
+
+    Every executable the engine can dispatch (one prefill per declared
+    bucket + the decode step) is traced, donation/jaxpr-audited, and
+    compiled for the budget rules — without executing on device.
+    Returns (findings, report).
+    """
+    from unicore_tpu.analysis import hlo_audit, trace_audit
+    from unicore_tpu.analysis.trace_audit import audit_donation, audit_jaxpr
+
+    th = dict(thresholds or {})
+    engine = engine or build_demo_serve_engine()
+    tol = hlo_audit.DEFAULT_TOLERANCE if tolerance is None else tolerance
+    findings = list(hlo_audit.audit_serve_recompiles(
+        engine.bucket_fn, engine.prefill_buckets(), engine.max_context,
+    ))
+    # every executable generate() can dispatch: all prefill buckets
+    # under the default greedy composition, plus the decode step under
+    # each sampling variant (the variants differ only in the
+    # _pick_tokens composition, identical between prefill and decode,
+    # so decode-only coverage of temp/topk audits the sampling paths
+    # without tripling the prefill compiles)
+    arts = dict(engine.trace_step_fns(sampling="greedy"))
+    for sampling in ("temp", "topk"):
+        got = engine.trace_step_fns(sampling=sampling, buckets=())
+        arts[f"decode-{sampling}"] = got["decode"]
+    scenario_stats = {}
+    scenarios_report = []
+    for name, art in sorted(arts.items()):
+        ctx = f"serve/{name}"
+        if log:
+            log(f"pass3: compiling {ctx}")
+        findings.extend(audit_jaxpr(
+            art["jaxpr"], context=ctx,
+            big_bytes=th.get("big_bytes", trace_audit.DEFAULT_BIG_BYTES),
+            quad_bytes=th.get("quad_bytes",
+                              trace_audit.DEFAULT_QUAD_BYTES),
+            upcast_min_elems=th.get(
+                "upcast_min_elems", trace_audit.DEFAULT_UPCAST_MIN_ELEMS
+            ),
+            pedantic=th.get("pedantic", False),
+        ))
+        findings.extend(audit_donation(art["lowered"], context=ctx))
+        compiled = art["lowered"].compile()
+        _, stats, _ = hlo_audit.audit_compiled(compiled, context=ctx)
+        scenario_stats[ctx] = stats
+        scenarios_report.append({"scenario": ctx, **stats})
+
+    fp = None
+    if budget_path is not None:
+        fp = hlo_audit.pass3_fingerprint()
+        if update_budgets and scenario_stats:
+            hlo_audit.update_budget_entries(budget_path, fp,
+                                            scenario_stats)
+        budgets = hlo_audit.load_budgets(budget_path)
+        for ctx, stats in scenario_stats.items():
+            entry = hlo_audit.budget_entry(budgets, fp, ctx)
+            findings.extend(hlo_audit.audit_comms_budget(
+                ctx, stats, entry, tolerance=tol
+            ))
+            findings.extend(hlo_audit.audit_memory_budget(
+                ctx, stats.get("peak_bytes"), entry, tolerance=tol
+            ))
+    return findings, {"fingerprint": fp, "scenarios": scenarios_report}
 
 
 def audit_bert_config(example_dir, *, variants=None, n_devices=None,

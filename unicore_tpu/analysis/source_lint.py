@@ -15,6 +15,12 @@ Rules (see docs/static_analysis.md for rationale and incidents):
 - UL105 dropout-dead-rate: a literal dropout rate that quantizes to
   exact identity or full drop at the uint8 keep resolution of
   ``ops/dropout.py`` (rates within 1/512 of 0 or 1).
+- UL106 where-nan-grad: ``jnp.where(cond, f(x), g(x))`` where a branch
+  applies a domain-restricted function (sqrt/log/arcsin/…, or a
+  division guarded by the condition itself) — ``where`` evaluates BOTH
+  branches, and autodiff propagates the untaken branch's NaN/Inf
+  cotangent through the select.  The fix is clamping the argument
+  (``jnp.sqrt(jnp.maximum(x, eps))``), which the rule recognizes.
 
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
@@ -52,6 +58,20 @@ _RNG_CONSTRUCTORS = {"RandomState", "default_rng", "Generator",
 # UL104: allowed path fragments — the stats slow path (meter formatting)
 _BLOCKING_OK_PATHS = ("logging" + os.sep,)
 
+# UL106: unary fns whose value or gradient is non-finite outside their
+# domain (sqrt'(0) = inf; log(0) = -inf; …)
+_WHERE_RISKY_UNARY = {
+    "sqrt", "rsqrt", "log", "log2", "log10", "log1p",
+    "arcsin", "arccos", "arctanh", "arccosh",
+    "asin", "acos", "atanh", "acosh", "reciprocal",
+}
+# UL106: wrapping the risky argument in one of these is the sanctioned
+# fix — the whole subtree is considered clamped
+_WHERE_CLAMP_FNS = {
+    "maximum", "minimum", "clip", "clamp", "abs", "where", "nan_to_num",
+    "exp", "softplus", "sigmoid",
+}
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -73,6 +93,7 @@ class _ModuleLint(ast.NodeVisitor):
         self.findings = []
         # alias tracking: import numpy as np / import random as rnd
         self.np_aliases = {"numpy"}
+        self.jnp_aliases = {"jnp"}
         self.random_aliases = set()
         self.jax_aliases = {"jax"}
         self.jitted_names = set()
@@ -89,10 +110,19 @@ class _ModuleLint(ast.NodeVisitor):
                     name = alias.asname or alias.name
                     if alias.name == "numpy":
                         self.np_aliases.add(name)
+                    elif alias.name == "jax.numpy":
+                        self.jnp_aliases.add(name)
                     elif alias.name == "random":
                         self.random_aliases.add(name)
                     elif alias.name == "jax":
                         self.jax_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for alias in node.names:
+                        if alias.name == "numpy":
+                            self.jnp_aliases.add(
+                                alias.asname or alias.name
+                            )
             elif isinstance(node, ast.Call) and self._is_jax_jit(node.func):
                 if node.args and isinstance(node.args[0], ast.Name):
                     self.jitted_names.add(node.args[0].id)
@@ -301,6 +331,118 @@ class _ModuleLint(ast.NodeVisitor):
                     f"is silently not applied (ops/dropout.py)",
                 )
 
+    # -- UL106 ---------------------------------------------------------
+
+    def _module_aliases(self):
+        """Attribute roots (jnp/np/jax/...) — never 'data' names; the
+        name-overlap heuristic must not count `jnp` appearing in both
+        the condition and a denominator as a shared value."""
+        return self.np_aliases | self.jnp_aliases | self.jax_aliases
+
+    def _value_names(self, node):
+        """Dotted names of VALUE references in an expression: ``x``,
+        ``self.temperature`` — as full chains, so ``self.eps`` in a
+        condition and ``self.temperature`` in a denominator do not
+        collide on the bare ``self`` root.  Chains rooted at a module
+        alias (``jnp.sum``) are function references, not data, and are
+        excluded."""
+        aliases = self._module_aliases()
+        out = set()
+        skip = set()
+        for sub in ast.walk(node):
+            if id(sub) in skip:
+                continue
+            if isinstance(sub, ast.Attribute):
+                chain = _attr_chain(sub)
+                if chain is None:
+                    continue
+                # consume the whole chain: its inner Name/Attribute
+                # nodes must not ALSO register as bare names
+                for inner in ast.walk(sub):
+                    if inner is not sub:
+                        skip.add(id(inner))
+                if chain.split(".")[0] not in aliases:
+                    out.add(chain)
+            elif isinstance(sub, ast.Name) and sub.id not in aliases:
+                out.add(sub.id)
+        return out
+
+    @staticmethod
+    def _contains_clamp(node):
+        """True when the expression passes through a clamp call anywhere
+        (``sqrt(maximum(x, eps))`` — the argument IS the clamp;
+        ``sqrt(maximum(x, eps) + y)`` still counts)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain and chain.split(".")[-1] in _WHERE_CLAMP_FNS:
+                    return True
+        return False
+
+    def _find_risky(self, node, cond_names):
+        """First hazardous subexpression in a where() branch: a
+        domain-restricted unary call on a non-constant argument, a
+        ``x ** <fractional/negative>`` power, or a division whose
+        denominator shares a name with the condition (the
+        guard-the-denominator-with-where signature).  A clamp call
+        (maximum/clip/abs/…) sanctions its whole subtree."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            tail = chain.split(".")[-1] if chain else None
+            if tail in _WHERE_CLAMP_FNS:
+                return None
+            if (tail in _WHERE_RISKY_UNARY and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                    and not self._contains_clamp(node.args[0])):
+                return f"'{tail}'"
+        elif isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Div):
+                den = node.right
+                if (not isinstance(den, ast.Constant)
+                        and not self._contains_clamp(den)
+                        and self._value_names(den) & cond_names):
+                    return "a division whose denominator the condition " \
+                           "guards"
+            elif isinstance(node.op, ast.Pow):
+                exp = node.right
+                if (isinstance(exp, ast.Constant)
+                        and isinstance(exp.value, (int, float))
+                        and (exp.value < 0
+                             or float(exp.value) != int(exp.value))
+                        and not isinstance(node.left, ast.Constant)
+                        and not self._contains_clamp(node.left)):
+                    return f"'** {exp.value}'"
+        for child in ast.iter_child_nodes(node):
+            got = self._find_risky(child, cond_names)
+            if got:
+                return got
+        return None
+
+    def _check_where_nan(self, node):
+        chain = _attr_chain(node.func)
+        if chain is None or chain.split(".")[-1] != "where":
+            return
+        root = chain.split(".")[0]
+        if root not in (self.np_aliases | self.jnp_aliases
+                        | self.jax_aliases):
+            return
+        if len(node.args) < 3:
+            return
+        cond_names = self._value_names(node.args[0])
+        for branch in node.args[1:3]:
+            risky = self._find_risky(branch, cond_names)
+            if risky:
+                self.emit(
+                    "UL106", "where-nan-grad", "warning", node,
+                    f"where() branch applies {risky}, which is "
+                    f"non-finite (in value or gradient) outside its "
+                    f"domain — where evaluates BOTH branches, and the "
+                    f"untaken branch's NaN/Inf cotangent propagates "
+                    f"through the select; clamp the argument instead "
+                    f"(e.g. sqrt(maximum(x, eps)))",
+                )
+                return
+
     # -- traversal -----------------------------------------------------
 
     def visit_With(self, node):
@@ -318,6 +460,7 @@ class _ModuleLint(ast.NodeVisitor):
             self._check_dataset_rng(node)
         self._check_blocking(node)
         self._check_dropout_rate(node)
+        self._check_where_nan(node)
         self.generic_visit(node)
 
     def _visit_functions(self):

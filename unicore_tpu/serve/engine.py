@@ -41,7 +41,10 @@ class ServeResult:
     evictions: int
 
 
-def _pow2_bucket(n, floor=8):
+PREFILL_BUCKET_FLOOR = 8
+
+
+def _pow2_bucket(n, floor=PREFILL_BUCKET_FLOOR):
     b = floor
     while b < n:
         b *= 2
@@ -78,6 +81,10 @@ class ServeEngine:
             chaos_rate=chaos_rate, chaos_rng=chaos_rng,
         )
         self.pages = self._init_pages()
+        # the prompt-length -> compile-bucket map, overridable so the
+        # static audit (analysis/hlo_audit.py UL205) can check that it
+        # never produces a lowering outside prefill_buckets()
+        self.bucket_fn = _pow2_bucket
         self._prefill_fns = {}
         self._decode_fns = {}
         self.stats = {
@@ -195,6 +202,63 @@ class ServeEngine:
             )
         return fn
 
+    # -- static-audit surface ------------------------------------------
+
+    def prefill_buckets(self):
+        """The declared prefill compile surface: the pow2 bucket chain
+        covering every admissible prompt length.  ``trace_step_fns``
+        traces one executable per entry, and UL205 fails when
+        ``bucket_fn`` can produce a bucket outside this set."""
+        out = []
+        b = PREFILL_BUCKET_FLOOR
+        while True:
+            out.append(b)
+            if b >= self.max_context:
+                break
+            b *= 2
+        return tuple(out)
+
+    def trace_step_fns(self, *, sampling="greedy", buckets=None):
+        """AOT trace + lower every serve executable WITHOUT executing.
+
+        The static-analysis subsystem audits the returned artifacts
+        exactly like ``Trainer.trace_train_step``'s: the jaxpr for
+        Pass-1 rules (upcast/callback/fp64), ``args_info`` for donation
+        coverage, and the lowered module for the Pass-3 compiled-HLO
+        audit.  All step inputs are ShapeDtypeStructs — nothing touches
+        a device — and the traced jit objects are the SAME cached
+        closures ``generate()`` dispatches through, so the audit sees
+        the program that serves."""
+        import jax
+
+        def sds(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+            )
+
+        def s(*shape, dtype=jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        params, pages = sds(self.params), sds(self.pages)
+        W = self.table_width
+        arts = {}
+        buckets = self.prefill_buckets() if buckets is None else buckets
+        for b in buckets:
+            traced = self._prefill_fn(b, sampling).trace(
+                params, pages, s(1, b), s(1, b), s(1, W), s(b), s(1),
+                s(1), s(1), s(1, dtype=jnp.float32), s(1),
+            )
+            arts[f"prefill-b{b}"] = {
+                "jaxpr": traced.jaxpr, "lowered": traced.lower(),
+            }
+        B = self.max_batch
+        traced = self._decode_step_fn(sampling).trace(
+            params, pages, s(B, 1), s(B, 1), s(B, W), s(B), s(B), s(B),
+            s(B), s(B, dtype=jnp.float32), s(B),
+        )
+        arts["decode"] = {"jaxpr": traced.jaxpr, "lowered": traced.lower()}
+        return arts
+
     # -- host-side step assembly ---------------------------------------
 
     def _padded_table(self, seq):
@@ -206,7 +270,7 @@ class ServeEngine:
     def _prefill(self, seq):
         prefix = seq.prefix()
         n = len(prefix)
-        bucket = _pow2_bucket(n)
+        bucket = self.bucket_fn(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prefix
         positions = np.full((1, bucket), -1, np.int32)
@@ -368,7 +432,7 @@ class ServeEngine:
             # admit() hands back fresh AND resumed sequences — a resumed
             # one re-prefills prompt+generated, recreating exactly the
             # KV state its eviction dropped
-            admitted = sched.admit(bucket=_pow2_bucket)
+            admitted = sched.admit(bucket=self.bucket_fn)
             for seq in admitted:
                 self._prefill(seq)
             sched.chaos_preempt()

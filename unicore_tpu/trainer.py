@@ -58,6 +58,18 @@ from unicore_tpu.optim.lr_scheduler import build_lr_scheduler
 logger = logging.getLogger(__name__)
 
 
+def estimate_peak_bytes(ma):
+    """Peak-HBM estimate from a compiled executable's
+    ``memory_analysis()``: live arguments + outputs + temporaries minus
+    donated aliases.  Shared by the runtime pre-flight OOM check and the
+    Pass-3 static audit (``analysis/hlo_audit.py``) so both gate on the
+    same number."""
+    return int(
+        ma.argument_size_in_bytes + ma.output_size_in_bytes
+        + ma.temp_size_in_bytes - ma.alias_size_in_bytes
+    )
+
+
 def _norm_index(idx, shape):
     """Canonicalize a shard's index (tuple of slices) as ((start, stop), ...)
     — hashable, layout-independent keys for shard-file entries."""
@@ -856,10 +868,7 @@ class Trainer:
         and warn with per-buffer numbers + knobs before anything runs."""
         try:
             ma = compiled.memory_analysis()
-            est = int(
-                ma.argument_size_in_bytes + ma.output_size_in_bytes
-                + ma.temp_size_in_bytes - ma.alias_size_in_bytes
-            )
+            est = estimate_peak_bytes(ma)
             self._memory_analysis = {
                 "arguments_gb": ma.argument_size_in_bytes / 1e9,
                 "outputs_gb": ma.output_size_in_bytes / 1e9,
