@@ -592,6 +592,66 @@ def test_where_nan_grad_tracks_jnp_import_forms(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL107 swallowed-io-error
+# ---------------------------------------------------------------------
+
+def test_swallowed_io_error_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "ckpt.py", """
+        import os, pickle
+        def save(obj, fn):
+            try:
+                with open(fn, "wb") as fh:
+                    pickle.dump(obj, fh)
+            except Exception:
+                pass
+        def sweep(paths):
+            for p in paths:
+                try:
+                    os.remove(p)
+                except:
+                    continue
+    """)
+    assert sum(1 for f in found if f.rule == "UL107") == 2
+
+
+def test_swallowed_io_error_silent_on_sanctioned_forms(tmp_path):
+    found = _lint_snippet(tmp_path, "ckpt.py", """
+        import os, pickle, logging
+        logger = logging.getLogger(__name__)
+        def narrow(fn):
+            try:
+                os.remove(fn)
+            except FileNotFoundError:
+                pass  # deliberate: prune races are benign
+        def logged(obj, fn):
+            try:
+                with open(fn, "wb") as fh:
+                    pickle.dump(obj, fh)
+            except Exception:
+                logger.error("save failed", exc_info=True)
+                raise
+        def no_io(x):
+            try:
+                return float(x)
+            except Exception:
+                pass
+    """)
+    assert "UL107" not in rules_of(found)
+
+
+def test_swallowed_io_error_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "ckpt.py", """
+        import os
+        def f(p):
+            try:
+                os.remove(p)
+            except Exception:  # unicore-lint: disable=UL107
+                pass
+    """)
+    assert "UL107" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # Pass 3: HLO parsing primitives (pure text, no compile)
 # ---------------------------------------------------------------------
 

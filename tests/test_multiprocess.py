@@ -52,6 +52,13 @@ def test_two_process_trainer_and_collectives():
             p.kill()
         pytest.fail("multi-process workers timed out:\n" +
                     "\n".join(o or "" for o in outs))
+    if any("WORKER_SKIP_NO_MP_ALLGATHER" in (o or "") for o in outs):
+        # capability probe: some jaxlib CPU backends cannot run
+        # multi-process computations at all ("Multiprocess computations
+        # aren't implemented on the CPU backend") — nothing this test
+        # covers is reachable there, so skip instead of failing every
+        # run in such containers
+        pytest.skip("CPU backend lacks multiprocess allgather")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert "WORKER_OK" in out, f"worker {i} incomplete:\n{out[-4000:]}"
@@ -72,6 +79,22 @@ def _worker(pid, port):
     )
     assert jax.process_count() == 2
     assert len(jax.devices()) == 4
+
+    # capability probe BEFORE the real assertions: a trivial allgather
+    # either works (backend supports multi-process computations) or
+    # raises the backend's not-implemented error, in which case the
+    # host test skips cleanly instead of failing
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    try:
+        multihost_utils.process_allgather(np.zeros((1,), dtype=np.int32))
+    except Exception as e:
+        if ("aren't implemented" in str(e)
+                or "not implemented" in str(e).lower()):
+            print("WORKER_SKIP_NO_MP_ALLGATHER", pid)
+            return
+        raise
 
     import logging
     from argparse import Namespace

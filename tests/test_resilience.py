@@ -1,0 +1,710 @@
+"""Fault-tolerance subsystem tests (unicore_tpu/resilience +
+checkpoint_utils integrity layer).
+
+The end-to-end SIGKILL/corrupt/resume proof lives in
+``tools/unicore_chaos.py`` (run by CI; ``test_chaos_harness_*`` below is
+the slow-marked pytest wrapper).  Everything here is the fast unit and
+trainer-integration tier: guard math, escalation ladder, snapshot-ring
+rewind, watchdog, preemption flag, checksum verification, and the
+CheckpointManager restore edge cases (missing final marker, stale
+scratch, checksum-mismatch fallback)."""
+
+import os
+import pickle
+import signal
+import time
+from argparse import Namespace
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unicore_tpu import checkpoint_utils, metrics
+from unicore_tpu.losses.unicore_loss import UnicoreLoss
+from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.resilience import (
+    AnomalyGuardConfig,
+    EscalationPolicy,
+    GracefulShutdown,
+    SnapshotRing,
+    StepWatchdog,
+    guard_init,
+    guard_update,
+    read_trajectory,
+    restore_state,
+    snapshot_state,
+)
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+VOCAB, DIM = 13, 16
+
+
+# ---------------------------------------------------------------------
+# toy trainer (same shape as tests/test_trainer.py)
+# ---------------------------------------------------------------------
+
+class ToyModel(BaseUnicoreModel):
+    @nn.compact
+    def __call__(self, src_tokens, deterministic=True, **kwargs):
+        x = nn.Embed(VOCAB, DIM, name="embed")(src_tokens)
+        return nn.Dense(VOCAB, name="out")(x)
+
+
+class ToyLoss(UnicoreLoss):
+    def forward(self, model, params, sample, rng=None, is_training=True):
+        logits = model.apply(
+            {"params": params}, **sample["net_input"],
+            deterministic=not is_training,
+        )
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        target = sample["target"]
+        nll = -jnp.take_along_axis(lprobs, target[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(nll)
+        n = jnp.asarray(np.prod(target.shape), dtype=jnp.float32)
+        return loss, n, {"loss": loss, "sample_size": n}
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train"):
+        loss = sum(float(l.get("loss", 0)) for l in logging_outputs)
+        n = sum(float(l.get("sample_size", 0)) for l in logging_outputs)
+        metrics.log_scalar("loss", loss / max(n, 1), n, round=3)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train):
+        return True
+
+
+class ToyTask(UnicoreTask):
+    pass
+
+
+def make_args(**over):
+    d = dict(
+        seed=1, update_freq=[1], clip_norm=0.0, ema_decay=-1.0,
+        fp16=False, bf16=False, bf16_sr=False, stats_lag=0,
+        optimizer="adam", lr=[1e-2], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0,
+        lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
+        fp16_init_scale=4.0, max_update=100, max_epoch=0,
+        tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+    )
+    d.update(over)
+    return Namespace(**d)
+
+
+def make_trainer(**over):
+    args = make_args(**over)
+    task = ToyTask(args)
+    return Trainer(args, task, ToyModel(), ToyLoss(task))
+
+
+def make_batch(rng, bsz=8, seq=8):
+    toks = rng.randint(0, VOCAB, size=(bsz, seq)).astype(np.int64)
+    return {"net_input": {"src_tokens": toks}, "target": toks.copy()}
+
+
+def poison_params(trainer):
+    from unicore_tpu.distributed import replicated
+
+    bad = jax.device_get(trainer.state["params"])
+    bad["embed"]["embedding"] = np.full_like(
+        bad["embed"]["embedding"], np.inf
+    )
+    trainer.state["params"] = jax.device_put(
+        jax.tree_util.tree_map(jnp.asarray, bad), replicated(trainer.mesh)
+    )
+
+
+# ---------------------------------------------------------------------
+# guard math (pure, on device scalars)
+# ---------------------------------------------------------------------
+
+def test_guard_spike_detection_and_warmup():
+    cfg = AnomalyGuardConfig(spike_factor=3.0, window=8, warmup=4,
+                             act_on_spike=True)
+    g = guard_init()
+    over = jnp.zeros((), bool)
+    # warmup: even a huge jump must not fire before `warmup` clean steps
+    for loss in (1.0, 1.01, 0.99):
+        g, anomalous, spike = guard_update(g, jnp.float32(loss), over, cfg)
+        assert not bool(spike) and not bool(anomalous)
+    g, _, spike = guard_update(g, jnp.float32(100.0), over, cfg)
+    assert not bool(spike), "fired during warmup"
+    # the warmup-step outlier DID fold in; rebuild a tight baseline
+    for loss in (1.0, 1.0, 1.01, 0.99, 1.0, 1.0, 1.0, 1.0):
+        g, _, _ = guard_update(g, jnp.float32(loss), over, cfg)
+    baseline = float(g["loss_ema"])
+    g, anomalous, spike = guard_update(g, jnp.float32(1e4), over, cfg)
+    assert bool(spike) and bool(anomalous)
+    assert int(g["streak"]) == 1 and int(g["spikes"]) == 1
+    # the anomalous loss must NOT drag the EMA
+    assert float(g["loss_ema"]) == pytest.approx(baseline)
+    # clean step resets the streak
+    g, anomalous, _ = guard_update(g, jnp.float32(1.0), over, cfg)
+    assert not bool(anomalous) and int(g["streak"]) == 0
+
+
+def test_guard_detect_only_without_act_on_spike():
+    cfg = AnomalyGuardConfig(spike_factor=3.0, window=8, warmup=2,
+                             act_on_spike=False)
+    g = guard_init()
+    for loss in (1.0, 1.0, 1.0, 1.0):
+        g, _, _ = guard_update(g, jnp.float32(loss), jnp.zeros((), bool), cfg)
+    g, anomalous, spike = guard_update(
+        g, jnp.float32(1e4), jnp.zeros((), bool), cfg
+    )
+    assert bool(spike) and not bool(anomalous)  # counted, not skipped
+    # overflow still skips regardless of the flag
+    g, anomalous, _ = guard_update(
+        g, jnp.float32(1.0), jnp.ones((), bool), cfg
+    )
+    assert bool(anomalous)
+
+
+def test_guard_nonfinite_loss_does_not_poison_ema():
+    cfg = AnomalyGuardConfig(spike_factor=3.0, window=8, warmup=2)
+    g = guard_init()
+    for loss in (1.0, 1.0, 1.0):
+        g, _, _ = guard_update(g, jnp.float32(loss), jnp.zeros((), bool), cfg)
+    ema = float(g["loss_ema"])
+    g, _, _ = guard_update(
+        g, jnp.float32(np.nan), jnp.ones((), bool), cfg
+    )
+    assert float(g["loss_ema"]) == pytest.approx(ema)
+    assert np.isfinite(float(g["loss_ema"]))
+
+
+def test_guard_ema_tracks_decaying_loss():
+    """The baseline is a WINDOWED ema, not an all-run mean: after a loss
+    decay it must converge to the new level within ~window steps (an
+    all-run mean would stay stranded between the two levels and let a
+    genuine late-training spike hide under the inflated sigma)."""
+    cfg = AnomalyGuardConfig(spike_factor=3.0, window=4, warmup=2)
+    g = guard_init()
+    over = jnp.zeros((), bool)
+    for _ in range(20):
+        g, _, _ = guard_update(g, jnp.float32(1.0), over, cfg)
+    for _ in range(40):
+        g, _, _ = guard_update(g, jnp.float32(0.0), over, cfg)
+    assert float(g["loss_ema"]) < 0.01
+
+
+def test_escalation_ladder_order():
+    cfg = AnomalyGuardConfig(escalate=True, backoff_after=2,
+                             rewind_after=3, abort_after=5)
+    pol = EscalationPolicy(cfg, has_scaler=True, has_ring=True)
+    assert pol.decide(False, 0) == "none"
+    assert pol.decide(True, 1) == "skip"
+    assert pol.decide(True, 2) == "backoff"
+    # the backoff rung halves the fp16 loss scale — meaningless (and not
+    # performed by the jitted step) for a finite loss spike, so a
+    # spike-only streak skips there instead
+    assert pol.decide(True, 2, overflow=False) == "skip"
+    assert pol.decide(True, 3) == "rewind"
+    assert pol.decide(True, 5) == "abort"
+    # no ring: the rewind stage is unreachable, backoff holds until abort
+    pol2 = EscalationPolicy(cfg, has_scaler=True, has_ring=False)
+    assert pol2.decide(True, 4) == "backoff"
+    # no scaler either: skip only
+    pol3 = EscalationPolicy(cfg, has_scaler=False, has_ring=False)
+    assert pol3.decide(True, 4) == "skip"
+    # legacy mode (no --anomaly-guard): always plain skip
+    pol4 = EscalationPolicy(
+        AnomalyGuardConfig(escalate=False), has_scaler=True, has_ring=True
+    )
+    assert pol4.decide(True, 99) == "skip"
+
+
+# ---------------------------------------------------------------------
+# trainer integration: skip / rewind / abort
+# ---------------------------------------------------------------------
+
+def test_injected_nonfinite_grad_skips_without_poisoning_state(
+        rng, monkeypatch):
+    """Acceptance criterion: an injected nonfinite gradient is skipped
+    without touching optimizer state, and metrics record the stage."""
+    monkeypatch.setenv("UNICORE_TPU_CHAOS_INJECT", "nonfinite:1")
+    metrics.reset()
+    trainer = make_trainer(anomaly_guard=True)
+    batch = make_batch(rng)
+    with metrics.aggregate("train") as agg:
+        trainer.train_step([batch])           # dispatch 0: clean
+        before = jax.device_get(
+            {"params": trainer.state["params"],
+             "opt_state": trainer.state["opt_state"]}
+        )
+        n_before = trainer.get_num_updates()
+        trainer.train_step([batch])           # dispatch 1: poisoned grads
+        after = jax.device_get(
+            {"params": trainer.state["params"],
+             "opt_state": trainer.state["opt_state"]}
+        )
+        # skipped: no update count, params AND moments bit-identical
+        assert trainer.get_num_updates() == n_before
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(jax.device_get(trainer.state["guard"]["skips"])) == 1
+        assert int(jax.device_get(trainer.state["guard"]["streak"])) == 1
+        # the escalation stage landed in metrics
+        smoothed = agg.get_smoothed_values()
+        assert smoothed.get("anomaly_skip", 0) >= 1
+        # next step is clean again: streak resets, training continues
+        logs = trainer.train_step([batch])
+        assert np.isfinite(logs[0]["loss"])
+        assert trainer.get_num_updates() == n_before + 1
+        assert int(jax.device_get(trainer.state["guard"]["streak"])) == 0
+
+
+def test_escalation_rewind_restores_last_good_state(rng):
+    metrics.reset()
+    trainer = make_trainer(
+        anomaly_guard=True, snapshot_interval_updates=1,
+        snapshot_ring_size=2, anomaly_rewind_after=2, anomaly_abort_after=6,
+    )
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+        trainer.train_step([batch])
+    assert len(trainer._snapshot_ring) == 2
+    good = jax.device_get(trainer.state["params"])
+    poison_params(trainer)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])   # streak 1: skip (params stay poisoned)
+        assert trainer.get_num_updates() == 2
+        trainer.train_step([batch])   # streak 2: REWIND to last-good
+    restored = jax.device_get(trainer.state["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(good),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert trainer.get_num_updates() == 2
+    assert trainer._escalation.rewinds == 1
+    # and the run keeps training cleanly from the restored state
+    with metrics.aggregate("train"):
+        logs = trainer.train_step([batch])
+    assert np.isfinite(logs[0]["loss"])
+    assert trainer.get_num_updates() == 3
+
+
+def test_rewind_streak_carries_to_abort(rng):
+    """A persistent fault must not loop skip->rewind forever: the
+    anomaly streak carries ACROSS a rewind (the snapshot was taken on a
+    clean step with streak 0), so --anomaly-abort-after stays a real
+    bound on consecutive anomalies."""
+    metrics.reset()
+    trainer = make_trainer(
+        anomaly_guard=True, snapshot_interval_updates=1,
+        snapshot_ring_size=2, anomaly_rewind_after=2, anomaly_abort_after=3,
+    )
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+        trainer.train_step([batch])
+        poison_params(trainer)
+        trainer.train_step([batch])   # streak 1: skip
+        trainer.train_step([batch])   # streak 2: rewind (streak carried)
+        assert trainer._escalation.rewinds == 1
+        assert int(jax.device_get(trainer.state["guard"]["streak"])) == 2
+        poison_params(trainer)        # the fault persists past the rewind
+        with pytest.raises(FloatingPointError, match="escalation exhausted"):
+            trainer.train_step([batch])  # streak 3: abort, not rewind again
+
+
+def test_escalation_abort_after_threshold(rng):
+    metrics.reset()
+    trainer = make_trainer(anomaly_guard=True, anomaly_abort_after=2)
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+        poison_params(trainer)
+        trainer.train_step([batch])  # streak 1: skip
+        with pytest.raises(FloatingPointError, match="escalation exhausted"):
+            trainer.train_step([batch])  # streak 2: abort
+
+
+def test_legacy_nonscaler_abort_preserved(rng):
+    """Without --anomaly-guard, bf16/fp32 still aborts on the FIRST
+    non-finite step (the pre-resilience contract)."""
+    metrics.reset()
+    trainer = make_trainer()
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        trainer.train_step([batch])
+        poison_params(trainer)
+        with pytest.raises(FloatingPointError, match="Non-finite gradients"):
+            trainer.train_step([batch])
+
+
+def test_injected_spike_skips_update(rng, monkeypatch):
+    monkeypatch.setenv("UNICORE_TPU_CHAOS_INJECT", "spike:4")
+    metrics.reset()
+    trainer = make_trainer(
+        anomaly_guard=True, loss_spike_factor=3.0, loss_spike_window=8,
+        loss_spike_warmup=2,
+    )
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        for _ in range(4):
+            trainer.train_step([batch])      # dispatches 0-3: clean
+        n = trainer.get_num_updates()
+        before = jax.device_get(trainer.state["params"])
+        trainer.train_step([batch])          # dispatch 4: spiked loss stat
+        after = jax.device_get(trainer.state["params"])
+    assert trainer.get_num_updates() == n   # skipped
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(jax.device_get(trainer.state["guard"]["spikes"])) == 1
+
+
+def test_resume_with_skip_is_bit_exact(rng, tmp_path, monkeypatch):
+    """dispatch_count persistence: a run with an anomaly skip before the
+    checkpoint resumes onto the IDENTICAL dropout streams, so the
+    continuation is bit-exact vs the uninterrupted run."""
+    monkeypatch.setenv("UNICORE_TPU_CHAOS_INJECT", "nonfinite:1")
+    metrics.reset()
+    batches = [make_batch(rng) for _ in range(6)]
+    t1 = make_trainer(anomaly_guard=True)
+    with metrics.aggregate("train"):
+        for b in batches[:4]:
+            t1.train_step([b])  # dispatch 1 is skipped -> 3 updates
+    assert t1.get_num_updates() == 3
+    fn = os.path.join(str(tmp_path), "ckpt.pt")
+    t1.save_checkpoint(fn, {"train_iterator": {"epoch": 1}})
+
+    t2 = make_trainer(anomaly_guard=True)
+    t2.load_checkpoint(fn)
+    t2.init_state(batches[0])
+    assert t2._dispatch_count == 4  # restored verbatim, skip included
+    with metrics.aggregate("train"):
+        for b in batches[4:]:
+            t1.train_step([b])
+            t2.train_step([b])
+    p1 = jax.device_get(t1.state["params"])
+    p2 = jax.device_get(t2.state["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------
+# snapshot ring / watchdog / preemption / trajectory units
+# ---------------------------------------------------------------------
+
+def test_snapshot_ring_roundtrip():
+    state = {
+        "step": jnp.int32(7),
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+    }
+    snap = snapshot_state(state)
+    state["w"] = state["w"] * 0 - 1.0  # diverge the live state
+    back = restore_state(snap)
+    assert int(back["step"]) == 7
+    np.testing.assert_array_equal(
+        np.asarray(back["w"]), np.arange(12, dtype=np.float32).reshape(3, 4)
+    )
+    ring = SnapshotRing(size=2)
+    for u in (1, 2, 3):
+        ring.take(state, u, u)
+    assert len(ring) == 2
+    assert ring.latest()[0] == 3  # newest survives, oldest evicted
+
+
+def test_watchdog_fires_and_disarms():
+    fired = []
+    dog = StepWatchdog(0.15, on_timeout=lambda phase, t: fired.append(phase))
+    with dog.armed("fast-phase"):
+        time.sleep(0.01)
+    time.sleep(0.4)
+    assert fired == [], "fired although the phase finished in time"
+    try:
+        with dog.armed("slow-phase"):
+            time.sleep(0.6)
+        assert fired == ["slow-phase"]
+        assert dog.fired
+    finally:
+        dog.close()
+
+
+def test_graceful_shutdown_flag_and_uninstall():
+    shutdown = GracefulShutdown(signals=(signal.SIGTERM,)).install()
+    try:
+        assert not shutdown.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        assert shutdown.requested and shutdown.signum == signal.SIGTERM
+    finally:
+        shutdown.uninstall()
+
+
+def test_trajectory_writer_roundtrip_and_torn_line(tmp_path):
+    from unicore_tpu.resilience import TrajectoryWriter
+
+    path = str(tmp_path / "traj.jsonl")
+    w = TrajectoryWriter(path)
+    w.record(update=1, dispatch=0, loss=1.0 / 3.0, skipped=False,
+             action="none")
+    w.record(update=2, dispatch=1, loss=2.0 / 3.0, skipped=False,
+             action="none")
+    w.close()
+    with open(path, "a") as f:
+        f.write('{"update": 3, "dispa')  # SIGKILL mid-write
+    records = read_trajectory(path)
+    assert len(records) == 2
+    assert records[0]["loss"] == 1.0 / 3.0  # exact float round trip
+
+
+# ---------------------------------------------------------------------
+# checkpoint integrity + CheckpointManager restore edge cases
+# ---------------------------------------------------------------------
+
+def test_atomic_save_writes_final_marker_and_verifies(tmp_path):
+    p = str(tmp_path / "c.pt")
+    checkpoint_utils.atomic_save({"x": 1}, p)
+    assert os.path.exists(p + ".sum")
+    assert checkpoint_utils.file_integrity(p) == "ok"
+    assert pickle.loads(checkpoint_utils.read_verified(p)) == {"x": 1}
+
+
+def test_read_verified_detects_corruption(tmp_path):
+    p = str(tmp_path / "c.pt")
+    checkpoint_utils.atomic_save({"x": list(range(100))}, p)
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[:10] + bytes([data[10] ^ 0xFF]) + data[11:])
+    assert checkpoint_utils.file_integrity(p) == "torn"
+    with pytest.raises(checkpoint_utils.CheckpointIntegrityError):
+        checkpoint_utils.read_verified(p, retries=2, backoff=0.01)
+
+
+def test_read_verified_retries_transient_io(tmp_path, monkeypatch):
+    p = str(tmp_path / "c.pt")
+    checkpoint_utils.atomic_save({"x": 1}, p)
+    real_open = open
+    fails = {"n": 1}
+
+    def flaky_open(path, *a, **kw):
+        if str(path) == p and fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient NFS hiccup")
+        return real_open(path, *a, **kw)
+
+    import builtins
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    payload = checkpoint_utils.read_verified(p, retries=3, backoff=0.01)
+    assert pickle.loads(payload) == {"x": 1}
+    assert fails["n"] == 0
+
+
+def _manager_args(tmp_path, **over):
+    d = dict(
+        save_dir=str(tmp_path / "save"),
+        tmp_save_dir=str(tmp_path / "scratch"),
+        no_save=False, save_interval=1, save_interval_updates=0,
+        keep_interval_updates=-1, keep_last_epochs=-1,
+        keep_best_checkpoints=-1, best_checkpoint_metric="loss",
+        maximize_best_checkpoint_metric=False, no_epoch_checkpoints=False,
+        no_last_checkpoints=False, checkpoint_suffix="",
+        restore_file="checkpoint_last.pt", finetune_from_model=None,
+        reset_optimizer=False, reset_lr_scheduler=False, reset_meters=False,
+        reset_dataloader=False, optimizer_overrides="{}",
+    )
+    d.update(over)
+    os.makedirs(d["save_dir"], exist_ok=True)
+    os.makedirs(d["tmp_save_dir"], exist_ok=True)
+    return Namespace(**d)
+
+
+class _StubTrainer:
+    """Duck-typed trainer for CheckpointManager.restore: records which
+    checkpoint actually loaded and propagates integrity errors exactly
+    like the real ``Trainer.load_checkpoint`` read path."""
+
+    def __init__(self):
+        self.loaded_path = None
+
+    def load_checkpoint(self, path, *a, **kw):
+        if not checkpoint_utils.checkpoint_exists(path):
+            return None
+        state = checkpoint_utils.load_checkpoint_to_cpu(path)
+        self.loaded_path = path
+        return state["extra_state"]
+
+    def get_train_iterator(self, epoch, load_dataset=True, **kw):
+        class _Itr:
+            def __init__(self):
+                self.epoch = epoch
+
+            def load_state_dict(self, sd):
+                pass
+
+        return _Itr()
+
+    def init_total_train_steps(self, epoch_itr):
+        pass
+
+    def lr_step(self, epoch):
+        pass
+
+
+def _write_round(save_dir, updates, names):
+    payload = {
+        "model": {"params": {"w": np.arange(updates, dtype=np.float32)}},
+        "optimizer_history": [{"num_updates": updates}],
+        "extra_state": {"train_iterator": {"epoch": 1}, "updates": updates},
+    }
+    for name in names:
+        checkpoint_utils.atomic_save(payload, os.path.join(save_dir, name))
+
+
+def test_manager_falls_back_on_checksum_mismatch(tmp_path):
+    args = _manager_args(tmp_path)
+    _write_round(args.save_dir, 3, ["checkpoint_1_3.pt"])
+    time.sleep(0.02)
+    _write_round(args.save_dir, 6, ["checkpoint_1_6.pt", "checkpoint_last.pt"])
+    # tear the newest round (both names — restore must reach round 3)
+    for name in ("checkpoint_last.pt", "checkpoint_1_6.pt"):
+        p = os.path.join(args.save_dir, name)
+        data = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(data[:-8] + b"DEADBEEF")
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    trainer = _StubTrainer()
+    extra, _ = mgr.restore(trainer)
+    assert extra["updates"] == 3
+    assert trainer.loaded_path.endswith("checkpoint_1_3.pt")
+    mgr.close()
+
+
+def test_manager_falls_back_on_missing_final_marker(tmp_path):
+    """A save that died between the data rename and the .sum rename (or a
+    half-copied finalize) leaves a torn file without a trustworthy
+    marker; restore must fall back to the previous intact round."""
+    args = _manager_args(tmp_path)
+    _write_round(args.save_dir, 3, ["checkpoint_1_3.pt"])
+    time.sleep(0.02)
+    _write_round(args.save_dir, 6, ["checkpoint_last.pt"])
+    last = os.path.join(args.save_dir, "checkpoint_last.pt")
+    os.remove(last + ".sum")           # final marker never landed...
+    data = open(last, "rb").read()
+    with open(last, "wb") as f:
+        f.write(data[:len(data) // 2])  # ...because the copy was torn
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    trainer = _StubTrainer()
+    extra, _ = mgr.restore(trainer)
+    assert extra["updates"] == 3
+    assert trainer.loaded_path.endswith("checkpoint_1_3.pt")
+    mgr.close()
+
+
+def test_manager_explicit_restore_file_fails_loudly(tmp_path):
+    """--restore-file names ONE checkpoint; if it is torn the run must
+    not silently train from some other state."""
+    other = str(tmp_path / "elsewhere")
+    os.makedirs(other)
+    p = os.path.join(other, "model.pt")
+    checkpoint_utils.atomic_save({"model": {}, "extra_state": {}}, p)
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[:-4] + b"XXXX")
+    args = _manager_args(tmp_path, restore_file=p)
+    _write_round(args.save_dir, 3, ["checkpoint_last.pt"])  # tempting twin
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    with pytest.raises(checkpoint_utils.CheckpointIntegrityError):
+        mgr.restore(_StubTrainer())
+    mgr.close()
+
+
+def test_manager_sweeps_stale_scratch(tmp_path):
+    args = _manager_args(tmp_path)
+    scratch = args.tmp_save_dir
+    # torn data file (mismatched marker) — a crash mid-_finalize
+    torn = os.path.join(scratch, "checkpoint_1_9.pt")
+    checkpoint_utils.atomic_save({"x": 1}, torn)
+    with open(torn, "ab") as f:
+        f.write(b"GARBAGE")
+    # interrupted atomic_save temp
+    with open(os.path.join(scratch, "checkpoint_1_9.pt.tmp"), "wb") as f:
+        f.write(b"partial")
+    # INTACT scratch file (crash after write, before copy): must survive
+    ok = os.path.join(scratch, "checkpoint_1_12.pt")
+    checkpoint_utils.atomic_save({"x": 2}, ok)
+
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    assert not os.path.exists(torn)
+    assert not os.path.exists(torn + ".sum")
+    assert not os.path.exists(torn + ".tmp")
+    assert os.path.exists(ok) and os.path.exists(ok + ".sum")
+    mgr.close()
+
+
+def test_shard_integrity_error_propagates(tmp_path):
+    """A torn .shard file raises CheckpointIntegrityError from
+    load_shard_entries — the signal the restore fallback consumes."""
+    main = str(tmp_path / "c.pt")
+    checkpoint_utils.write_checkpoint(
+        {"model": {}}, {"params/w": [(((0, 2),), np.zeros(2))]},
+        main, is_master=True, process_index=0, shard_token="tok",
+    )
+    shard = checkpoint_utils.shard_file(main, 0)
+    data = open(shard, "rb").read()
+    with open(shard, "wb") as f:
+        f.write(data[:-4] + b"XXXX")
+    with pytest.raises(checkpoint_utils.CheckpointIntegrityError):
+        checkpoint_utils.load_shard_entries(main, 0, token="tok")
+
+
+def test_missing_shard_sidecar_in_integrity_round_is_torn(tmp_path):
+    """The SIGKILL-mid-finalize window the chaos harness caught: the
+    shard's data copy landed but its .sum never did, and the bytes then
+    rotted.  A rot that only flips float payload still unpickles, so
+    the pre-integrity compat path ("no sidecar -> load unverified")
+    would silently install garbage weights — when the round's MAIN file
+    proves the writer was integrity-aware, a sidecar-less shard must be
+    treated as torn instead."""
+    main = str(tmp_path / "c.pt")
+    checkpoint_utils.write_checkpoint(
+        {"model": {}}, {"params/w": [(((0, 2),), np.zeros(2))]},
+        main, is_master=True, process_index=0, shard_token="tok",
+    )
+    shard = checkpoint_utils.shard_file(main, 0)
+    os.remove(shard + ".sum")  # the marker never landed
+    with pytest.raises(checkpoint_utils.CheckpointIntegrityError):
+        checkpoint_utils.load_shard_entries(main, 0, token="tok")
+    assert checkpoint_utils.file_integrity(shard) == "torn"
+    # the REVERSE window (main's marker missing, shard's landed) is the
+    # same signature seen from the other sibling
+    checkpoint_utils.write_checkpoint(
+        {"model": {}}, {"params/w": [(((0, 2),), np.zeros(2))]},
+        main, is_master=True, process_index=0, shard_token="tok",
+    )
+    os.remove(main + ".sum")
+    with pytest.raises(checkpoint_utils.CheckpointIntegrityError):
+        checkpoint_utils.load_checkpoint_to_cpu(main)
+    # a round with NO sidecars at all stays loadable (pre-integrity
+    # checkpoints must not break)
+    lone = str(tmp_path / "legacy.pt")
+    checkpoint_utils.atomic_save({"model": {}, "extra_state": {}}, lone)
+    os.remove(lone + ".sum")
+    assert checkpoint_utils.load_checkpoint_to_cpu(lone) is not None
+
+
+# ---------------------------------------------------------------------
+# chaos harness (slow: full subprocess training runs; CI runs the tool
+# directly with the corrupt + inject legs)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_harness_sigkill_resume(tmp_path):
+    import tools.unicore_chaos as chaos
+
+    rc = chaos.main([
+        "--workdir", str(tmp_path / "chaos"), "--max-update", "8",
+        "--save-interval-updates", "3", "--keep",
+    ])
+    assert rc == 0

@@ -432,6 +432,10 @@ def test_legacy_in_proj_layout_restores(rng, tmp_path):
     flatten_in_proj(ckpt["model"])
     with open(fn, "wb") as f:
         pickle.dump(ckpt, f)
+    # a hand-rewritten checkpoint (like any external conversion tool's
+    # output) carries no integrity sidecar; the stale one must go or the
+    # verified read correctly rejects the edit as a torn file
+    os.remove(fn + ".sum")
 
     t2 = make(make_args())
     t2.load_checkpoint(fn)

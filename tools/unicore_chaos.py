@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""unicore-chaos: prove that a killed-and-resumed run IS the run.
+
+The harness trains the tiny BERT config twice over the same generated
+corpus:
+
+1. the ORACLE — uninterrupted to ``--max-update``, recording every
+   update's loss at full float precision (``--trajectory-file``);
+2. the CHAOS run — SIGKILLed at a (seeded-)random step, optionally with
+   a chosen checkpoint file corrupted afterwards (``--corrupt
+   shard|main``), then resumed with the identical command line.
+
+It then asserts the combined chaos trajectory is BIT-EXACT against the
+oracle: every record (keyed by the dispatch counter, which advances on
+anomaly skips too) must carry the identical float loss — the proof that
+checkpoint resume restores the dataloader position, the RNG streams,
+the loss-scaler/guard state, and the params to the last saved bit, and
+that the torn-file fallback rewinds to the previous INTACT checkpoint
+whose re-done updates replay identically.
+
+Fault-injection legs (exercising the in-loop anomaly guard end to end):
+
+  --inject nonfinite:K   poison the gradients of dispatch K in BOTH
+                         runs (UNICORE_TPU_CHAOS_INJECT) and assert the
+                         step was skipped without desyncing the
+                         trajectories — the optimizer state provably
+                         survived, since every later loss matches;
+  --graceful             send SIGTERM instead of SIGKILL and assert the
+                         run checkpointed-and-exited cleanly (exit 0)
+                         before resuming.
+
+CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
+(SIGKILL at a random step + one torn shard + bit-exact resume) and the
+``--inject nonfinite:4`` leg.  Exit code 0 iff every assertion holds.
+"""
+
+import argparse
+import glob
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ----------------------------------------------------------------------
+# corpus + run plumbing
+# ----------------------------------------------------------------------
+
+def build_corpus(data_dir, seed=0):
+    from unicore_tpu.data import IndexedRecordWriter
+    import numpy as np
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    words = ["tok%d" % i for i in range(40)]
+    with open(os.path.join(data_dir, "dict.txt"), "w") as f:
+        for w in words:
+            f.write(f"{w} 1\n")
+    for split, n in (("train", 96), ("valid", 16)):
+        with IndexedRecordWriter(os.path.join(data_dir, split + ".rec")) as w:
+            for _ in range(n):
+                length = rng.randint(6, 24)
+                w.write(list(rng.choice(words, size=length)))
+    return data_dir
+
+
+def train_cmd(args, data_dir, save_dir, traj_file):
+    cmd = [
+        sys.executable, "-m", "unicore_tpu_cli.train", data_dir,
+        "--user-dir", os.path.join(REPO, "examples", "bert"),
+        "--task", "bert", "--loss", "masked_lm", "--arch", "bert_base",
+        "--encoder-layers", "1", "--encoder-embed-dim", "32",
+        "--encoder-ffn-embed-dim", "64", "--encoder-attention-heads", "2",
+        "--max-seq-len", "32", "--pre-tokenized",
+        "--batch-size", "8", "--optimizer", "adam", "--lr", "1e-3",
+        "--lr-scheduler", "fixed", "--seed", str(args.seed),
+        "--max-update", str(args.max_update),
+        "--save-interval-updates", str(args.save_interval_updates),
+        "--save-dir", save_dir, "--tmp-save-dir", save_dir + "_tmp",
+        "--trajectory-file", traj_file,
+        "--disable-validation", "--no-epoch-checkpoints",
+        "--log-interval", "1", "--log-format", "simple",
+        "--required-batch-size-multiple", "1", "--num-workers", "0", "--cpu",
+        "--anomaly-guard",
+        # spike-rule scale for a ~12-update run (the production defaults
+        # of warmup 16 / window 64 would keep the rule dormant for the
+        # whole harness run, making the spike:K leg untestable); the
+        # 1.0 margin keeps benign step-to-step wiggle (~0.1) from firing
+        # while the injected 1e3x spike sails past it
+        "--loss-spike-warmup", "2", "--loss-spike-window", "8",
+        "--loss-spike-margin", "1.0",
+    ]
+    if args.fsdp_size > 1:
+        cmd += ["--fsdp-size", str(args.fsdp_size)]
+    return cmd
+
+
+def run_env(args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    if args.devices > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices}"
+        )
+    else:
+        env.pop("XLA_FLAGS", None)
+    if args.inject:
+        env["UNICORE_TPU_CHAOS_INJECT"] = args.inject
+    else:
+        env.pop("UNICORE_TPU_CHAOS_INJECT", None)
+    return env
+
+
+def traj_lines(path):
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb") as f:
+        return f.read().count(b"\n")
+
+
+def run_to_completion(cmd, env, timeout=900):
+    proc = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"training run failed rc={proc.returncode}:\n"
+            f"{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}"
+        )
+    return proc.stdout + proc.stderr
+
+
+def run_and_kill(cmd, env, traj_file, kill_at_lines, *, graceful,
+                 timeout=900):
+    """Start a run and SIGKILL (or SIGTERM) it once the trajectory shows
+    ``kill_at_lines`` processed steps.  Returns (captured output, killed)."""
+    with open(traj_file + ".victim.log", "w") as log:
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        deadline = time.monotonic() + timeout
+        killed = False
+        while proc.poll() is None:
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError("victim run timed out before the kill")
+            if traj_lines(traj_file) >= kill_at_lines:
+                if graceful:
+                    proc.send_signal(signal.SIGTERM)
+                    rc = proc.wait(timeout=300)
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"graceful shutdown exited rc={rc} (expected 0)"
+                        )
+                else:
+                    proc.kill()
+                    proc.wait(timeout=60)
+                killed = True
+                break
+            time.sleep(0.05)
+    with open(traj_file + ".victim.log", encoding="utf-8") as f:
+        out = f.read()
+    if not killed and traj_lines(traj_file) < kill_at_lines:
+        raise RuntimeError(
+            f"run finished before reaching {kill_at_lines} steps:\n"
+            f"{out[-3000:]}"
+        )
+    return out, killed
+
+
+# ----------------------------------------------------------------------
+# corruption
+# ----------------------------------------------------------------------
+
+def corrupt_newest_round(save_dir, kind, rng):
+    """Flip bytes in the newest checkpoint round's files of ``kind``.
+
+    A save round writes the same state under several names
+    (checkpoint_<e>_<u>.pt + checkpoint_last.pt, plus per-process
+    ``.shardN`` siblings); corrupting only one name would let restore
+    trivially pick its intact twin, so the WHOLE newest round is torn —
+    the fallback must reach back to the previous round.  The round is
+    identified by the UPDATE NUMBER in the interval filename (mtimes of
+    consecutive rounds can be closer than the clock's resolution)."""
+    import re
+
+    mains = glob.glob(os.path.join(save_dir, "checkpoint*.pt"))
+    if not mains:
+        raise RuntimeError(f"no checkpoints in {save_dir} to corrupt")
+    by_update = []
+    for m in mains:
+        g = re.fullmatch(r"checkpoint_\d+_(\d+)\.pt", os.path.basename(m))
+        if g:
+            by_update.append((int(g.group(1)), m))
+    round_mains = [os.path.join(save_dir, "checkpoint_last.pt")]
+    if by_update:
+        round_mains.append(max(by_update)[1])
+    round_mains = [m for m in round_mains if os.path.exists(m)]
+    torn = []
+    for main in round_mains:
+        if kind == "shard":
+            targets = [
+                fn for fn in glob.glob(main + ".shard*")
+                if not fn.endswith(".sum")
+            ]
+            if not targets:
+                raise RuntimeError(
+                    f"--corrupt shard: no shard files next to {main} "
+                    f"(need --fsdp-size > 1 with --devices > 1)"
+                )
+        else:
+            targets = [main]
+        for path in targets:
+            with open(path, "r+b") as f:
+                data = f.read()
+                pos = rng.randrange(len(data) // 4, 3 * len(data) // 4)
+                f.seek(pos)
+                f.write(bytes(b ^ 0xFF for b in data[pos:pos + 64]))
+            torn.append(os.path.basename(path))
+    return torn
+
+
+# ----------------------------------------------------------------------
+# trajectory comparison
+# ----------------------------------------------------------------------
+
+def compare_trajectories(oracle, chaos_records):
+    """Every chaos record must equal the oracle record of the same
+    dispatch, bit for bit.  Returns (mismatches, compared)."""
+    by_dispatch = {}
+    for r in oracle:
+        by_dispatch[r["dispatch"]] = r
+    mismatches = []
+    compared = 0
+    for r in chaos_records:
+        ref = by_dispatch.get(r["dispatch"])
+        if ref is None:
+            mismatches.append({"dispatch": r["dispatch"],
+                               "error": "dispatch absent from oracle"})
+            continue
+        compared += 1
+        for key in ("loss", "skipped", "action", "update", "streak"):
+            if r.get(key) != ref.get(key):
+                mismatches.append({
+                    "dispatch": r["dispatch"], "field": key,
+                    "oracle": ref.get(key), "chaos": r.get(key),
+                })
+    return mismatches, compared
+
+
+# ----------------------------------------------------------------------
+# main
+# ----------------------------------------------------------------------
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="unicore-chaos",
+        description="SIGKILL/corrupt/resume a real training run and "
+                    "assert the trajectory is bit-exact vs an "
+                    "uninterrupted oracle",
+    )
+    p.add_argument("--workdir", default=None,
+                   help="scratch directory (default: a fresh tempdir)")
+    p.add_argument("--max-update", type=int, default=12)
+    p.add_argument("--save-interval-updates", type=int, default=3)
+    p.add_argument("--seed", type=int, default=7,
+                   help="seeds the corpus, the training run, the kill "
+                        "step, and the corruption offsets")
+    p.add_argument("--devices", type=int, default=1,
+                   help="virtual CPU device count for the runs")
+    p.add_argument("--fsdp-size", type=int, default=1,
+                   help="fsdp axis of the victim runs (>1 produces the "
+                        ".shard files --corrupt shard tears)")
+    p.add_argument("--corrupt", choices=("none", "shard", "main"),
+                   default="none",
+                   help="after the kill, tear the newest checkpoint "
+                        "round's files of this kind; restore must fall "
+                        "back to the previous intact round")
+    p.add_argument("--inject", default=None, metavar="KIND:DISPATCH",
+                   help="fault injection for BOTH runs, e.g. "
+                        "'nonfinite:4' (UNICORE_TPU_CHAOS_INJECT)")
+    p.add_argument("--graceful", action="store_true",
+                   help="SIGTERM instead of SIGKILL: also asserts the "
+                        "preemption checkpoint-and-exit path returns 0")
+    p.add_argument("--kills", type=int, default=1,
+                   help="how many kill+resume cycles before the final "
+                        "run to completion")
+    p.add_argument("--json", default=None, help="write the report here")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the workdir for inspection")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import tempfile
+
+    from unicore_tpu.resilience import read_trajectory
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="unicore_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    rng = random.Random(args.seed)
+    data_dir = build_corpus(os.path.join(workdir, "data"), seed=args.seed)
+    env = run_env(args)
+    report = {
+        "workdir": workdir, "max_update": args.max_update,
+        "corrupt": args.corrupt, "inject": args.inject,
+        "graceful": bool(args.graceful), "kills": [], "torn_files": [],
+        "fallback_used": False,
+    }
+
+    # -- oracle ---------------------------------------------------------
+    oracle_traj = os.path.join(workdir, "oracle.jsonl")
+    print(f"[chaos] oracle run -> {oracle_traj}", flush=True)
+    run_to_completion(
+        train_cmd(args, data_dir, os.path.join(workdir, "oracle_ckpt"),
+                  oracle_traj), env,
+    )
+    oracle = read_trajectory(oracle_traj)
+    assert oracle and oracle[-1]["update"] == args.max_update, (
+        f"oracle did not reach {args.max_update} updates: {oracle[-2:]}"
+    )
+
+    # -- chaos: kill / corrupt / resume cycles --------------------------
+    chaos_traj = os.path.join(workdir, "chaos.jsonl")
+    save_dir = os.path.join(workdir, "chaos_ckpt")
+    cmd = train_cmd(args, data_dir, save_dir, chaos_traj)
+    for cycle in range(args.kills):
+        # a corrupt leg tears the whole newest round, so at least TWO
+        # rounds must be on disk before the kill or there is nothing
+        # intact to fall back to
+        rounds_needed = 2 if args.corrupt != "none" else 1
+        lo = rounds_needed * args.save_interval_updates + 1
+        hi = max(lo + 1, args.max_update - 1)
+        kill_at = rng.randrange(lo, hi)
+        already = traj_lines(chaos_traj)
+        print(f"[chaos] cycle {cycle}: kill after {kill_at} new steps "
+              f"({'SIGTERM' if args.graceful else 'SIGKILL'})", flush=True)
+        out, _ = run_and_kill(
+            cmd, env, chaos_traj, already + kill_at, graceful=args.graceful,
+        )
+        report["kills"].append({"cycle": cycle, "kill_at": kill_at})
+        if args.graceful and "preemption" not in out:
+            raise RuntimeError(
+                "graceful leg: no preemption notice in output:\n"
+                + out[-2000:]
+            )
+        if args.corrupt != "none":
+            torn = corrupt_newest_round(save_dir, args.corrupt, rng)
+            print(f"[chaos] tore {torn}", flush=True)
+            report["torn_files"].extend(torn)
+
+    print("[chaos] resuming to completion", flush=True)
+    out = run_to_completion(cmd, env)
+    if "Loaded checkpoint" not in out:
+        raise RuntimeError("resume did not load a checkpoint:\n" + out[-2000:])
+    report["fallback_used"] = "FALLBACK checkpoint" in out
+    if args.corrupt != "none" and not report["fallback_used"]:
+        raise RuntimeError(
+            "corruption leg: resume did not report a torn-checkpoint "
+            "fallback:\n" + out[-3000:]
+        )
+
+    # -- verdict --------------------------------------------------------
+    chaos_records = read_trajectory(chaos_traj)
+    assert chaos_records[-1]["update"] == args.max_update, (
+        f"chaos run did not reach {args.max_update}: {chaos_records[-2:]}"
+    )
+    mismatches, compared = compare_trajectories(oracle, chaos_records)
+    report["records_compared"] = compared
+    report["mismatches"] = mismatches[:20]
+    report["bit_exact"] = not mismatches
+
+    if args.inject:
+        kind, _, at = args.inject.partition(":")
+        at = int(at)
+        hit = [r for r in oracle if r["dispatch"] == at]
+        report["injection"] = {
+            "kind": kind, "dispatch": at,
+            "skipped": bool(hit and hit[0]["skipped"]),
+            "action": hit[0]["action"] if hit else None,
+        }
+        if not (hit and hit[0]["skipped"]):
+            raise RuntimeError(
+                f"injected {kind} at dispatch {at} was NOT skipped: {hit}"
+            )
+        later = [r for r in oracle if r["dispatch"] > at]
+        if not later or any(not _finite(r["loss"]) for r in later):
+            raise RuntimeError(
+                f"losses after the injected {kind} are not finite — the "
+                f"skip did not protect the state"
+            )
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    print(json.dumps(
+        {k: report[k] for k in ("bit_exact", "records_compared",
+                                "fallback_used", "torn_files", "kills")},
+        indent=2,
+    ))
+    if not args.keep and args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    if mismatches:
+        print(f"[chaos] FAIL: {len(mismatches)} trajectory mismatches",
+              file=sys.stderr)
+        return 1
+    print(f"[chaos] OK: {compared} records bit-exact vs oracle")
+    return 0
+
+
+def _finite(x):
+    return x == x and x not in (float("inf"), float("-inf"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,14 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   branches, and autodiff propagates the untaken branch's NaN/Inf
   cotangent through the select.  The fix is clamping the argument
   (``jnp.sqrt(jnp.maximum(x, eps))``), which the rule recognizes.
+- UL107 swallowed-io-error: a bare ``except:`` — or an ``except
+  Exception:``/``except BaseException:`` whose body is only
+  ``pass``/``continue`` — around IO calls (open/os/shutil/pickle/…).
+  In checkpoint paths a swallowed write error means the run believes a
+  save succeeded that never hit the disk, and the failure surfaces
+  days later as a missing resume point.  Narrow handlers
+  (``except FileNotFoundError:``) and handlers that log or re-raise
+  are fine.
 
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
@@ -71,6 +79,20 @@ _WHERE_CLAMP_FNS = {
     "maximum", "minimum", "clip", "clamp", "abs", "where", "nan_to_num",
     "exp", "softplus", "sigmoid",
 }
+
+# UL107: module roots whose calls mark a try block as an IO path
+_IO_MODULE_ROOTS = {"os", "shutil", "pickle", "glob", "tempfile", "io",
+                    "json", "gzip", "lzma", "lmdb"}
+# UL107: method tails that mark a call as IO regardless of receiver
+_IO_METHOD_TAILS = {
+    "read", "readline", "readlines", "write", "writelines", "flush",
+    "close", "seek", "unlink", "rename", "replace", "remove", "rmdir",
+    "mkdir", "makedirs", "copyfile", "copy", "copytree", "move", "dump",
+    "dumps", "load", "loads",
+}
+# UL107: broad handler types whose swallow is the hazard (narrow types
+# like FileNotFoundError/ImportError are deliberate control flow)
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
 
 
 def _attr_chain(node):
@@ -442,6 +464,78 @@ class _ModuleLint(ast.NodeVisitor):
                     f"(e.g. sqrt(maximum(x, eps)))",
                 )
                 return
+
+    # -- UL107 ---------------------------------------------------------
+
+    def _is_io_call(self, node):
+        chain = _attr_chain(node.func)
+        if chain is None:
+            return False
+        parts = chain.split(".")
+        if parts[0] == "open" or parts[-1] == "open":
+            return True
+        if parts[0] in _IO_MODULE_ROOTS and len(parts) > 1:
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and parts[-1] in _IO_METHOD_TAILS)
+
+    def _try_touches_io(self, try_node):
+        for stmt in try_node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and self._is_io_call(sub):
+                    return True
+        return False
+
+    @staticmethod
+    def _handler_swallows(handler):
+        """Body is pure pass/continue/constant — the error vanishes."""
+        return all(
+            isinstance(stmt, (ast.Pass, ast.Continue))
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant))
+            for stmt in handler.body
+        )
+
+    def _handler_is_broad(self, handler):
+        types = []
+        if handler.type is None:
+            return True, True  # bare except: also eats KeyboardInterrupt
+        if isinstance(handler.type, ast.Tuple):
+            types = list(handler.type.elts)
+        else:
+            types = [handler.type]
+        names = {
+            _attr_chain(t).split(".")[-1]
+            for t in types if _attr_chain(t) is not None
+        }
+        return bool(names & _BROAD_EXC_NAMES), False
+
+    def visit_Try(self, node):
+        if self._try_touches_io(node):
+            for handler in node.handlers:
+                broad, bare = self._handler_is_broad(handler)
+                if not broad:
+                    continue
+                if bare:
+                    self.emit(
+                        "UL107", "swallowed-io-error", "error", handler,
+                        "bare 'except:' around IO calls — it catches "
+                        "KeyboardInterrupt/SystemExit too, and in a "
+                        "checkpoint path a swallowed write error means "
+                        "the run believes a save landed that never hit "
+                        "the disk; catch OSError (or log and re-raise)",
+                    )
+                elif self._handler_swallows(handler):
+                    self.emit(
+                        "UL107", "swallowed-io-error", "error", handler,
+                        "'except Exception: pass' around IO calls "
+                        "swallows the error — in a checkpoint path the "
+                        "run believes a save landed that never hit the "
+                        "disk and the failure surfaces days later as a "
+                        "missing resume point; narrow the type, log, or "
+                        "re-raise",
+                    )
+        self.generic_visit(node)
 
     # -- traversal -----------------------------------------------------
 

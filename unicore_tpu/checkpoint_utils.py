@@ -21,37 +21,170 @@ message when handed a real torch zipfile.
 """
 
 import ast
+import hashlib
+import json
 import logging
 import os
 import pickle
 import re
 import shutil
+import time
 import traceback
 from multiprocessing.pool import ThreadPool
 
 logger = logging.getLogger(__name__)
 
 
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint file is torn: its bytes do not match the checksum its
+    ``.sum`` sidecar recorded at write time (or the file cannot be read
+    at all after retries).  Restore paths catch this and fall back to
+    the previous intact checkpoint."""
+
+
 # ----------------------------------------------------------------------
 # low-level IO
 # ----------------------------------------------------------------------
 
-def atomic_save(obj, filename, retries=3):
-    """Pickle ``obj`` to ``filename`` via tmp+rename; retried on IO errors.
+def _sum_path(filename):
+    return filename + ".sum"
+
+
+def _digest(payload):
+    return hashlib.sha256(payload).hexdigest()
+
+
+class _HashingWriter:
+    """File wrapper that sha256-hashes and counts bytes as pickle
+    streams through it — the ``.sum`` marker comes out of the write
+    itself, without materializing a second full copy of a multi-GB
+    checkpoint in host memory (``pickle.dumps`` would)."""
+
+    def __init__(self, fh):
+        self._fh = fh
+        self.hasher = hashlib.sha256()
+        self.size = 0
+
+    def write(self, data):
+        self.hasher.update(data)
+        self.size += len(data)
+        return self._fh.write(data)
+
+
+def atomic_save(obj, filename, retries=3, backoff=0.5):
+    """Pickle ``obj`` to ``filename`` via tmp+rename; retried with
+    exponential backoff on IO errors.
 
     Raises after the final retry — callers must not believe a failed write
     succeeded (a stale scratch file copied under ``checkpoint_best.pt``
-    would silently desync from the tracked best metric)."""
+    would silently desync from the tracked best metric).
+
+    Every write leaves a ``<filename>.sum`` sidecar (sha256 + size of
+    the exact bytes) — the FINAL MARKER of the save: the data file
+    renames into place first, the sidecar second, so a crash between
+    the two leaves a data file whose sidecar mismatches (or is stale)
+    and verified reads treat it as torn instead of silently loading a
+    half-written state."""
     for attempt in range(retries):
         try:
             with open(filename + ".tmp", "wb") as f:
-                pickle.dump(obj, f, protocol=4)
+                w = _HashingWriter(f)
+                pickle.dump(obj, w, protocol=4)
+            marker = json.dumps({
+                "algo": "sha256", "digest": w.hasher.hexdigest(),
+                "size": w.size,
+            }).encode()
+            with open(_sum_path(filename) + ".tmp", "wb") as f:
+                f.write(marker)
             os.replace(filename + ".tmp", filename)
+            os.replace(_sum_path(filename) + ".tmp", _sum_path(filename))
             return
         except Exception:
             if attempt == retries - 1:
                 logger.error(traceback.format_exc())
                 raise
+            time.sleep(backoff * (2 ** attempt))
+
+
+def _sidecar_required(filename):
+    """Is a missing ``.sum`` sidecar proof of a torn save for this file?
+
+    Pre-integrity checkpoints carry no sidecars at all, and refusing
+    them would break every old resume — so a lone file without one
+    loads unverified.  But when any SIBLING of the same save round
+    (the main file, or any ``.shardN``) carries a sidecar, the round
+    was written by integrity-aware code and this file's marker simply
+    never landed: ``_finalize`` copies data first and ``.sum`` second,
+    so a kill in that window leaves exactly this signature, and the
+    unverifiable bytes may have rotted since.  Treat as torn."""
+    import glob
+
+    main = re.sub(r"\.shard\d+$", "", filename)
+    if filename != main and os.path.exists(_sum_path(main)):
+        return True
+    return any(
+        re.fullmatch(r".*\.shard\d+\.sum", fn)
+        for fn in glob.glob(main + ".shard*")
+    )
+
+
+def read_verified(filename, retries=3, backoff=0.5):
+    """Read ``filename`` and verify it against its ``.sum`` sidecar.
+
+    Transient failures (OSError mid-read, a mismatch while a copy is
+    still landing) retry with exponential backoff; a PERSISTENT mismatch
+    raises :class:`CheckpointIntegrityError`.  A file without a sidecar
+    is accepted with a warning ONLY when its whole save round carries
+    none (a pre-integrity checkpoint); if any sibling has a sidecar,
+    the save was interrupted before this file's final marker landed
+    and the bytes cannot be trusted (:func:`_sidecar_required`)."""
+    last = None
+    for attempt in range(retries):
+        try:
+            with open(filename, "rb") as f:
+                payload = f.read()
+            if not os.path.exists(_sum_path(filename)):
+                if _sidecar_required(filename):
+                    raise CheckpointIntegrityError(
+                        f"{filename} has no .sum sidecar but its save "
+                        f"round does — the save/copy was interrupted "
+                        f"before the final marker landed; treating as "
+                        f"torn (fallback will use the previous intact "
+                        f"checkpoint)"
+                    )
+                logger.warning(
+                    "%s has no .sum sidecar (pre-integrity checkpoint); "
+                    "loading UNVERIFIED", filename,
+                )
+                return payload
+            with open(_sum_path(filename), "rb") as f:
+                marker = json.loads(f.read().decode())
+            if (len(payload) == marker.get("size")
+                    and _digest(payload) == marker.get("digest")):
+                return payload
+            last = CheckpointIntegrityError(
+                f"{filename} is torn: {len(payload)} bytes, sha256 "
+                f"{_digest(payload)[:12]}… does not match its .sum "
+                f"marker ({marker.get('size')} bytes, "
+                f"{str(marker.get('digest'))[:12]}…). If you edited the "
+                f"checkpoint intentionally, delete the stale "
+                f"{_sum_path(filename)}"
+            )
+        except FileNotFoundError:
+            raise  # not transient: nothing to back off for
+        except OSError as e:
+            last = e
+        logger.warning(
+            "checkpoint read %s failed (attempt %d/%d): %s",
+            filename, attempt + 1, retries, last,
+        )
+        if attempt < retries - 1:  # no pointless sleep before the raise
+            time.sleep(backoff * (2 ** attempt))
+    if isinstance(last, CheckpointIntegrityError):
+        raise last
+    raise CheckpointIntegrityError(
+        f"could not read {filename} after {retries} attempts: {last}"
+    ) from last
 
 
 # API-parity alias (reference name; the payload was never torch here)
@@ -123,17 +256,48 @@ def load_shard_entries(path, process_index=None, token=None):
         if not os.path.exists(files[0]):
             return {}
     else:
-        files = sorted(glob.glob(path + ".shard*"))
+        # exact .shardN files only: the glob also sees .sum sidecars
+        files = [
+            fn for fn in sorted(glob.glob(path + ".shard*"))
+            if re.fullmatch(r".*\.shard\d+", fn)
+        ]
     accepted = []
     for fn in files:
-        with open(fn, "rb") as f:
-            payload = pickle.load(f)
+        # verified read: a torn shard raises CheckpointIntegrityError
+        # and the restore path falls back to the previous intact
+        # checkpoint instead of materializing half-written weights.
+        # A sidecar-less shard in an integrity-era round is read
+        # UNVERIFIED only long enough to check its save token: a
+        # mismatch proves a stale leftover (old topology — skipped,
+        # same as any token mismatch); a match (or unreadable bytes)
+        # means the CURRENT save's finalize was interrupted before the
+        # marker landed and the shard cannot be trusted.
+        unverifiable = (not os.path.exists(_sum_path(fn))
+                        and _sidecar_required(fn))
+        if unverifiable:
+            try:
+                with open(fn, "rb") as f:
+                    payload = pickle.loads(f.read())
+            except Exception as e:
+                raise CheckpointIntegrityError(
+                    f"{fn} has no .sum sidecar and does not unpickle: {e}"
+                ) from e
+        else:
+            payload = pickle.loads(read_verified(fn))
         if token is not None and payload.get("token") != token:
             logger.warning(
                 "ignoring stale shard file %s (token %r != %r)",
                 fn, payload.get("token"), token,
             )
             continue
+        if unverifiable:
+            raise CheckpointIntegrityError(
+                f"{fn} belongs to the current save (token matches) but "
+                f"its .sum sidecar never landed — the finalize copy was "
+                f"interrupted and the bytes cannot be verified; treating "
+                f"as torn (fallback will use the previous intact "
+                f"checkpoint)"
+            )
         accepted.append((fn, payload))
     if token is None and accepted:
         # legacy main file with no token: the staleness filter above is
@@ -167,26 +331,61 @@ def load_shard_entries(path, process_index=None, token=None):
 def has_shard_files(path):
     import glob
 
-    return bool(glob.glob(path + ".shard*"))
+    return any(
+        re.fullmatch(r".*\.shard\d+", fn)
+        for fn in glob.glob(path + ".shard*")
+    )
 
 
 def checkpoint_exists(path):
     return os.path.exists(path)
 
 
+def file_integrity(path):
+    """Classify one checkpoint file: ``ok`` (bytes match the .sum
+    marker), ``unverified`` (no marker anywhere in its round — a
+    pre-integrity write), or ``torn`` (unreadable, marker unreadable,
+    mismatched, or marker missing while a round sibling has one)."""
+    try:
+        with open(path, "rb") as f:
+            payload = f.read()
+    except OSError:
+        return "torn"
+    sum_file = _sum_path(path)
+    if not os.path.exists(sum_file):
+        return "torn" if _sidecar_required(path) else "unverified"
+    try:
+        with open(sum_file, "rb") as f:
+            marker = json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return "torn"
+    ok = (len(payload) == marker.get("size")
+          and _digest(payload) == marker.get("digest"))
+    return "ok" if ok else "torn"
+
+
 def load_checkpoint_to_cpu(path, arg_overrides=None):
-    """Read a checkpoint into host memory (numpy pytree + metadata)."""
-    with open(path, "rb") as f:
-        magic = f.read(2)
-        f.seek(0)
-        if magic == b"PK":
-            raise ValueError(
-                f"{path} is a torch-format (zip) checkpoint; this framework "
-                "writes pickled numpy pytrees. Convert reference Uni-Core "
-                "weights first: python -m unicore_tpu.tools.convert_torch_checkpoint "
-                f"{path} <out.pt>"
-            )
-        state = pickle.load(f)
+    """Read a checkpoint into host memory (numpy pytree + metadata).
+
+    The read is checksum-verified against the ``.sum`` final marker
+    (with retry/backoff on transient IO errors); a torn file raises
+    :class:`CheckpointIntegrityError` for the caller's fallback."""
+    payload = read_verified(path)
+    if payload[:2] == b"PK":
+        raise ValueError(
+            f"{path} is a torch-format (zip) checkpoint; this framework "
+            "writes pickled numpy pytrees. Convert reference Uni-Core "
+            "weights first: python -m unicore_tpu.tools.convert_torch_checkpoint "
+            f"{path} <out.pt>"
+        )
+    try:
+        state = pickle.loads(payload)
+    except Exception as e:
+        # unpicklable bytes that PASSED the digest check (or carried no
+        # sidecar) are still a torn/corrupt checkpoint to the caller
+        raise CheckpointIntegrityError(
+            f"{path} does not unpickle: {e}"
+        ) from e
     if arg_overrides and state.get("args") is not None:
         for name, value in arg_overrides.items():
             setattr(state["args"], name, value)
@@ -247,9 +446,11 @@ def _prune(args, end_of_epoch):
         if reverse:
             survivors = survivors[::-1]
         for stale in survivors[limit:]:
-            # shard siblings go with the main file; removals are guarded
-            # (multi-process pruning races are benign on a shared FS)
-            for path in [stale] + glob.glob(stale + ".shard*"):
+            # shard and .sum siblings go with the main file; removals are
+            # guarded (multi-process pruning races are benign on a shared
+            # FS).  stale+".shard*" also matches the shards' sidecars.
+            for path in ([stale, _sum_path(stale)]
+                         + glob.glob(stale + ".shard*")):
                 try:
                     os.remove(path)
                     logger.info("removed old checkpoint %s", path)
@@ -296,6 +497,54 @@ class CheckpointManager:
             # slow, shared) save dir and prunes — reference
             # unicore_cli/train.py:60 + checkpoint_utils.py:22-75
             self._worker = ThreadPool(processes=1)
+            self._sweep_stale_scratch()
+
+    def _sweep_stale_scratch(self):
+        """Clear torn scratch files a crash mid-``_finalize`` left in the
+        tmp dir.  Only TORN files (missing/mismatched .sum) are removed:
+        a verified scratch file is a complete state the operator may
+        still want, so it is reported and left alone.  Nothing is
+        touched when the tmp dir IS the save dir — the files there are
+        the finals."""
+        import glob
+
+        a = self.args
+        if os.path.realpath(a.tmp_save_dir) == os.path.realpath(a.save_dir):
+            return
+        for fn in sorted(glob.glob(os.path.join(a.tmp_save_dir,
+                                                "checkpoint*.pt*"))):
+            if fn.endswith(".tmp"):
+                # half-written temp from an interrupted atomic_save:
+                # always safe to clear (a completed save renames it away)
+                logger.warning("removing interrupted-save temp %s", fn)
+                try:
+                    os.remove(fn)
+                except FileNotFoundError:
+                    pass
+                continue
+            if fn.endswith(".sum"):
+                continue
+            state = file_integrity(fn)
+            if state == "torn":
+                # bytes contradict the save's own .sum marker: this is
+                # provably a crashed write, never a usable checkpoint
+                logger.warning(
+                    "removing torn scratch checkpoint left by an "
+                    "interrupted save: %s", fn,
+                )
+                for p in (fn, _sum_path(fn)):
+                    try:
+                        os.remove(p)
+                    except FileNotFoundError:
+                        pass
+            else:
+                # intact or unverifiable: may be a complete state (or a
+                # user's file — tmp dir defaults to "./"); never delete
+                logger.warning(
+                    "%s scratch checkpoint %s was never copied to %s "
+                    "(crash before finalize?); leaving it for manual "
+                    "recovery", state, fn, a.save_dir,
+                )
 
     # -- save ----------------------------------------------------------
 
@@ -413,7 +662,12 @@ class CheckpointManager:
             if dst == src:
                 continue
             try:
+                # data first, .sum LAST: the sidecar is the final marker,
+                # so a crash mid-copy leaves a destination that verified
+                # reads reject (stale/missing marker) instead of a
+                # silently-torn checkpoint
                 shutil.copyfile(src, dst)
+                shutil.copyfile(_sum_path(src), _sum_path(dst))
                 copied_any = True
                 logger.info("copied %s -> %s", src, dst)
             except Exception:
@@ -422,8 +676,9 @@ class CheckpointManager:
         try:
             if copied_any and self.args.tmp_save_dir != self.args.save_dir:
                 for p in (scratch, shard_file(scratch, process_index)):
-                    if os.path.lexists(p):
-                        os.remove(p)
+                    for q in (p, _sum_path(p)):
+                        if os.path.lexists(q):
+                            os.remove(q)
             if is_master or has_shards:
                 _prune(self.args, end_of_epoch)
         except Exception:
@@ -482,16 +737,61 @@ class CheckpointManager:
             return a.finetune_from_model, {k: True for k in resets}
         return path, resets
 
+    def _restore_candidates(self, path):
+        """``path`` first, then — only for the default in-save-dir
+        restore — every other checkpoint in the save dir, newest first
+        by mtime.  An EXPLICIT --restore-file / --finetune-from-model
+        must fail loudly rather than silently train from some other
+        state the user never named."""
+        import glob
+
+        yield path
+        save_dir = os.path.realpath(self.args.save_dir)
+        if os.path.realpath(os.path.dirname(path) or ".") != save_dir:
+            return
+        others = [
+            fn for fn in glob.glob(os.path.join(self.args.save_dir,
+                                                "checkpoint*.pt"))
+            if os.path.realpath(fn) != os.path.realpath(path)
+        ]
+        others.sort(key=os.path.getmtime, reverse=True)
+        yield from others
+
     def restore(self, trainer, **itr_kwargs):
-        """Load the restore checkpoint (if any) and build the train iterator."""
+        """Load the restore checkpoint (if any) and build the train iterator.
+
+        A torn checkpoint (checksum mismatch on the main file or any
+        shard — e.g. the run died mid-save) falls back to the previous
+        intact checkpoint instead of killing the relaunch: losing one
+        save interval beats losing the run."""
         path, resets = self._resolve_restore()
-        extra_state = trainer.load_checkpoint(
-            path,
-            resets["optimizer"],
-            resets["lr_scheduler"],
-            ast.literal_eval(self.args.optimizer_overrides),
-            reset_meters=resets["meters"],
-        )
+        extra_state, last_err = None, None
+        for candidate in self._restore_candidates(path):
+            try:
+                extra_state = trainer.load_checkpoint(
+                    candidate,
+                    resets["optimizer"],
+                    resets["lr_scheduler"],
+                    ast.literal_eval(self.args.optimizer_overrides),
+                    reset_meters=resets["meters"],
+                )
+                if candidate != path:
+                    logger.warning(
+                        "resumed from FALLBACK checkpoint %s (%s was "
+                        "torn); updates since its save are re-run",
+                        candidate, path,
+                    )
+                break
+            except CheckpointIntegrityError as e:
+                logger.error(
+                    "checkpoint %s is torn (%s); trying the previous "
+                    "intact checkpoint", candidate, e,
+                )
+                last_err = e
+        else:
+            raise CheckpointIntegrityError(
+                f"no intact checkpoint found for {path}"
+            ) from last_err
         if (extra_state is not None and "best" in extra_state
                 and not resets["optimizer"] and not resets["meters"]):
             self.best.value = extra_state["best"]

@@ -230,6 +230,14 @@ class _EpochStream:
             self.n += 1
             yield batch
 
+    def close(self):
+        """Tear down the forked worker pool (graceful-shutdown path: a
+        preemption save must not leave orphan worker processes behind
+        to be hard-killed by the supervisor after the grace window)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
     def _pooled(self, todo):
         """Materialize with a worker pool, at most ~2x workers in flight so
         loading can't run an entire epoch ahead of the consumer.
@@ -441,6 +449,13 @@ class EpochBatchIterator:
 
     def end_of_epoch(self) -> bool:
         return self._active is not None and not self._active.has_next()
+
+    def close(self):
+        """Shut down the active/resumed streams' worker pools (called by
+        the train loop on graceful preemption exit)."""
+        for stream in (self._active, self._resumed):
+            if stream is not None:
+                stream.close()
 
     # -- checkpoint state ----------------------------------------------
 

@@ -229,7 +229,13 @@ def state_sharding(mesh, tree):
                 for d, ax in enumerate(tp):
                     if ax is not None and x.shape[d] % tp_size == 0:
                         dims[d] = ax
-        if fsdp_size > 1 and x.ndim:
+        if fsdp_size > 1 and x.ndim >= 2:
+            # 1-D leaves (norm scales/biases and their optimizer moments)
+            # REPLICATE: fsdp-sharding a [C] vector saves almost nothing,
+            # and its weight-aligned gradient reduction forces GSPMD to
+            # reshard the row-stat broadcasts of layer_norm's backward —
+            # the involuntary-full-remat warning (and UL202 byte cost)
+            # the fsdp2 compile used to carry.
             if (
                 x.ndim == 2
                 and dims[0] == "tensor"
@@ -253,6 +259,35 @@ def state_sharding(mesh, tree):
         return jax.sharding.NamedSharding(mesh, P(*dims))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def strip_axis(shardings, axis="fsdp"):
+    """Sharding pytree with ``axis`` removed from every dim spec.
+
+    The ZeRO compute layout: master params/moments STORE sharded over
+    ``fsdp``, but the step's forward/backward must run on gathered
+    weights and batch-sharded activations.  Constraining the
+    compute-dtype cast to this stripped layout makes XLA emit one
+    weight all-gather up front and keeps every activation (and its
+    cotangent) batch-sharded — without it, sharding propagation leaks
+    the storage layout into the loss graph and GSPMD full-remats the
+    layer_norm row-stat broadcasts (the fsdp2 ``[1,16,64]`` warning).
+    Tensor/seq axes survive: only ``axis`` is dropped."""
+    jax = _jax()
+    P = jax.sharding.PartitionSpec
+
+    def strip(s):
+        dims = []
+        for entry in s.spec:
+            if entry == axis:
+                entry = None
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a != axis)
+                entry = kept[0] if len(kept) == 1 else (kept or None)
+            dims.append(entry)
+        return jax.sharding.NamedSharding(s.mesh, P(*dims))
+
+    return jax.tree_util.tree_map(strip, shardings)
 
 
 def shard_batch(batch, mesh):

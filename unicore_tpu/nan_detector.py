@@ -55,3 +55,37 @@ def log_nonfinite_modules(model, params, sample, rngs=None):
     for name, n in bad:
         logger.warning("NanDetector: non-finite output in %s (%d values)", name, n)
     return bad
+
+
+def find_nonfinite_leaves(tree):
+    """Leaf paths in a host/device pytree holding non-finite values.
+
+    The state-tree counterpart of :func:`find_nonfinite_modules`: the
+    anomaly guard's abort path runs it over params AND optimizer moments
+    to certify (or refute) that the skip bypass kept the state clean —
+    a poisoned Adam moment with finite params is exactly the failure
+    mode a forward re-run cannot see."""
+    bad = []
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        n_bad = int((~np.isfinite(arr)).sum())
+        if n_bad:
+            name = "/".join(
+                str(getattr(p, "key", getattr(p, "name", p))) for p in path
+            )
+            bad.append((name, n_bad))
+    return bad
+
+
+def log_nonfinite_state(state, header="state"):
+    bad = find_nonfinite_leaves(state)
+    if not bad:
+        logger.info("NanDetector: %s is clean (all leaves finite)", header)
+    for name, n in bad:
+        logger.warning(
+            "NanDetector: non-finite %s leaf %s (%d values)", header, name, n
+        )
+    return bad

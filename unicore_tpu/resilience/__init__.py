@@ -1,0 +1,36 @@
+"""Fault-tolerance subsystem: the layer that keeps an unattended
+multi-day run alive through NaN spikes, preempted slices, hung device
+steps, and torn checkpoints.
+
+Four cooperating pieces (docs/fault_tolerance.md):
+
+- :mod:`anomaly` — the in-loop anomaly guard JITTED INTO the train step:
+  nonfinite-grad and loss-spike detection on device, with the host-side
+  escalation policy skip-update -> loss-scale backoff -> rewind to the
+  in-memory last-good snapshot ring -> abort (``log_nonfinite_modules``
+  runs before the abort).
+- :mod:`snapshot` — the last-good snapshot ring: periodic host copies of
+  the sharded TrainState, restorable without reassembling full arrays.
+- :mod:`preemption` — SIGTERM/SIGINT handlers for graceful
+  checkpoint-and-exit, and the step watchdog (:mod:`watchdog`) that
+  dumps diagnostics and force-exits on a hung device step.
+- :mod:`trajectory` — the per-update JSONL loss-trajectory writer the
+  chaos harness (``tools/unicore_chaos.py``) compares bit-exactly
+  against an uninterrupted oracle run.
+
+Checkpoint INTEGRITY (per-file checksums, verified reads with
+retry/backoff, fallback to the previous intact checkpoint) lives in
+``checkpoint_utils`` — it is the serialization layer's own concern; this
+package holds the run-time machinery.
+"""
+
+from .anomaly import (  # noqa: F401
+    AnomalyGuardConfig,
+    EscalationPolicy,
+    guard_init,
+    guard_update,
+)
+from .preemption import GracefulShutdown  # noqa: F401
+from .snapshot import SnapshotRing, snapshot_state, restore_state  # noqa: F401
+from .trajectory import TrajectoryWriter, read_trajectory  # noqa: F401
+from .watchdog import StepWatchdog  # noqa: F401

@@ -1,0 +1,175 @@
+"""In-loop anomaly guard: detection on device, escalation on host.
+
+Detection is pure scalar math folded into the jitted train step (a few
+flops per update — measured within noise): the guard state carries an
+EMA of the step loss and of its square, and a step is a SPIKE when its
+loss exceeds ``ema + max(factor * sigma, margin)`` after the warmup
+count.  Nonfinite grads are the existing ``grads_finite`` overflow
+signal; both OR into one ``anomalous`` flag that drives the same
+state-bypass skip the fp16 overflow path always used — an anomalous
+step never touches params, optimizer moments, EMA, or the step counter,
+so a single bad batch cannot poison the run.
+
+Escalation is host-side policy over the device-side counters
+(:class:`EscalationPolicy`): consecutive anomalies walk the ladder
+
+    skip-update  ->  loss-scale backoff (fp16)  ->  rewind to the
+    last-good snapshot ring  ->  abort (after ``log_nonfinite_modules``)
+
+with every stage counted in metrics (``anomaly_skip`` /
+``anomaly_backoff`` / ``anomaly_rewind``).  The guard state lives in
+the TrainState pytree, so checkpoints carry it and a resumed run
+escalates exactly like an uninterrupted one.
+"""
+
+import logging
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class AnomalyGuardConfig:
+    """Trace-time constants for the in-step guard + host policy.
+
+    ``spike_factor <= 0`` disables spike DETECTION entirely;
+    ``act_on_spike`` decides whether a detected spike skips the update
+    (``--anomaly-guard``) or is only counted.  The escalation
+    thresholds are counts of CONSECUTIVE anomalous steps."""
+
+    spike_factor: float = 4.0
+    spike_margin: float = 0.0
+    window: int = 64          # EMA horizon in clean steps
+    warmup: int = 16          # clean steps before spikes can fire
+    act_on_spike: bool = False
+    escalate: bool = False    # full ladder (else: legacy skip/abort only)
+    backoff_after: int = 2
+    rewind_after: int = 3
+    abort_after: int = 6
+
+    @classmethod
+    def from_args(cls, args):
+        return cls(
+            spike_factor=float(
+                getattr(args, "loss_spike_factor", 4.0) or 0.0
+            ),
+            spike_margin=float(
+                getattr(args, "loss_spike_margin", 0.0) or 0.0
+            ),
+            window=max(2, int(getattr(args, "loss_spike_window", 64) or 64)),
+            warmup=max(1, int(getattr(args, "loss_spike_warmup", 16) or 16)),
+            act_on_spike=bool(getattr(args, "anomaly_guard", False)),
+            escalate=bool(getattr(args, "anomaly_guard", False)),
+            backoff_after=int(getattr(args, "anomaly_backoff_after", 2) or 2),
+            rewind_after=int(getattr(args, "anomaly_rewind_after", 3) or 3),
+            abort_after=int(getattr(args, "anomaly_abort_after", 6) or 6),
+        )
+
+
+def guard_init():
+    """Fresh guard state (a TrainState subtree: all replicated scalars)."""
+    return {
+        "loss_ema": jnp.zeros((), jnp.float32),
+        "loss_emsq": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.int32),     # clean steps folded in
+        "streak": jnp.zeros((), jnp.int32),    # consecutive anomalies
+        "skips": jnp.zeros((), jnp.int32),     # total anomalous skips
+        "spikes": jnp.zeros((), jnp.int32),    # total spike detections
+    }
+
+
+def guard_update(guard, loss_mean, overflow, cfg: AnomalyGuardConfig):
+    """One guard step, inside the jitted train step.
+
+    Returns ``(new_guard, anomalous, spike)``.  ``anomalous`` is the
+    skip signal (overflow always; spike only under ``act_on_spike``);
+    the EMA folds in CLEAN steps only, so an anomaly cannot drag the
+    baseline toward itself and mask a follow-up spike."""
+    ema = guard["loss_ema"]
+    emsq = guard["loss_emsq"]
+    count = guard["count"]
+
+    detect = cfg.spike_factor > 0
+    if detect:
+        warm = count >= cfg.warmup
+        var = jnp.maximum(emsq - ema * ema, 0.0)
+        sigma = jnp.sqrt(var + 1e-12)
+        threshold = jnp.maximum(
+            cfg.spike_factor * sigma, jnp.float32(cfg.spike_margin)
+        )
+        # a nonfinite loss is the overflow signal's job; the spike rule
+        # must not also fire on it (and NaN > x is False anyway)
+        spike = jnp.logical_and(
+            warm, (loss_mean - ema) > jnp.maximum(threshold, 1e-12)
+        )
+    else:
+        spike = jnp.zeros((), bool)
+
+    anomalous = jnp.logical_or(
+        overflow, jnp.logical_and(spike, cfg.act_on_spike)
+    )
+    # fold ONLY clean, finite losses into the baseline
+    fold = jnp.logical_and(
+        jnp.logical_not(anomalous), jnp.isfinite(loss_mean)
+    )
+    beta = jnp.float32(1.0 - 1.0 / cfg.window)
+    # early steps average instead of decaying from the zero init: the
+    # effective decay grows 0, 1/2, 2/3, ... (a running mean) and caps
+    # at beta once count reaches the window — min, not max, or the
+    # baseline degenerates into an all-run mean that a long loss decay
+    # leaves stranded far above the current loss
+    eff = jnp.where(
+        count > 0, jnp.minimum(beta, 1.0 - 1.0 / (count + 1.0)), 0.0
+    ).astype(jnp.float32)
+    new_ema = jnp.where(fold, eff * ema + (1 - eff) * loss_mean, ema)
+    new_emsq = jnp.where(
+        fold, eff * emsq + (1 - eff) * loss_mean * loss_mean, emsq
+    )
+    new_guard = {
+        "loss_ema": new_ema,
+        "loss_emsq": new_emsq,
+        "count": count + fold.astype(jnp.int32),
+        "streak": jnp.where(anomalous, guard["streak"] + 1, 0),
+        "skips": guard["skips"] + anomalous.astype(jnp.int32),
+        "spikes": guard["spikes"] + spike.astype(jnp.int32),
+    }
+    return new_guard, anomalous, spike
+
+
+class EscalationPolicy:
+    """Host-side ladder over the device-side streak counter.
+
+    :meth:`decide` maps one processed step's guard stats to an action
+    string; the trainer executes it.  Stages are cumulative — a streak
+    of ``rewind_after`` has already skipped and backed off."""
+
+    ACTIONS = ("none", "skip", "backoff", "rewind", "abort")
+
+    def __init__(self, cfg: AnomalyGuardConfig, *, has_scaler, has_ring):
+        self.cfg = cfg
+        self.has_scaler = has_scaler
+        self.has_ring = has_ring
+        self.rewinds = 0
+        self.aborts = 0
+
+    def decide(self, anomalous: bool, streak: int,
+               overflow: bool = True) -> str:
+        """``overflow`` distinguishes the anomaly kind: the backoff
+        stage halves the fp16 loss scale, which only makes sense (and is
+        only performed by the jitted step) when the anomaly IS an
+        overflow — a finite loss spike says nothing about fp16 range,
+        so a spike-only streak skips at that rung instead."""
+        if not anomalous:
+            return "none"
+        if not self.cfg.escalate:
+            return "skip"
+        if streak >= self.cfg.abort_after:
+            return "abort"
+        if streak >= self.cfg.rewind_after and self.has_ring:
+            return "rewind"
+        if (streak >= self.cfg.backoff_after and self.has_scaler
+                and overflow):
+            return "backoff"
+        return "skip"

@@ -1,0 +1,79 @@
+"""Graceful preemption: SIGTERM/SIGINT -> checkpoint-and-exit.
+
+TPU slices get preempted with a SIGTERM and a short grace window
+(PAPERS.md: "Exploring the limits of Concurrency in ML Training on
+Google TPUs"); an unattended run that dies mid-epoch without a
+checkpoint re-pays every update since the last save interval.  The
+handler only SETS A FLAG — all real work (flush lagged stats, write the
+checkpoint, close worker pools) happens at the next step boundary on
+the main thread, because signal handlers must not touch the jax runtime
+mid-dispatch.
+
+A second SIGINT restores the default handler and re-raises, so an
+operator can still hard-kill a wedged run from the keyboard."""
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class GracefulShutdown:
+    """Install on the MAIN thread; poll :attr:`requested` per step."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum = None
+        self._previous = {}
+        self._installed = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def install(self):
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal raises from a worker thread; a resilience
+            # helper must not be the thing that kills the run
+            logger.warning(
+                "GracefulShutdown.install() skipped: not on main thread"
+            )
+            return self
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # interpreter shutting down
+                pass
+        self._previous = {}
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- handler -------------------------------------------------------
+
+    def _handle(self, signum, frame):
+        if self.requested and signum == signal.SIGINT:
+            # second Ctrl-C: the operator wants OUT, now
+            logger.warning("second SIGINT: restoring default handler")
+            self.uninstall()
+            raise KeyboardInterrupt
+        self.requested = True
+        self.signum = signum
+        logger.warning(
+            "received %s: will checkpoint and exit at the next step "
+            "boundary (send SIGINT again to abort immediately)",
+            signal.Signals(signum).name,
+        )

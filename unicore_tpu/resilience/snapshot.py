@@ -1,0 +1,86 @@
+"""Last-good snapshot ring: periodic host copies of the live TrainState.
+
+The rewind stage of the escalation ladder needs a KNOWN-GOOD state that
+survives a poisoned update without doubling HBM — so snapshots live in
+host memory, taken every ``--snapshot-interval-updates`` clean updates.
+
+Sharded state never assembles: each leaf is captured as its addressable
+per-device shards (``(device, np-copy)`` pairs) and restored with
+``jax.make_array_from_single_device_arrays`` under the original
+sharding — the same no-global-assembly discipline the sharded
+checkpoint path follows, so the ring works identically on a pure-DP
+single host and an fsdp/tp multi-host mesh (every host rewinds its own
+shards in lockstep)."""
+
+import collections
+import logging
+
+import numpy as np
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class _LeafSnapshot:
+    __slots__ = ("shape", "dtype", "sharding", "pieces")
+
+    def __init__(self, leaf):
+        self.shape = tuple(leaf.shape)
+        self.dtype = leaf.dtype
+        self.sharding = leaf.sharding
+        # copy=True: the live buffers are donated to the next step, and
+        # on CPU np.asarray of a device array can be a zero-copy view
+        self.pieces = [
+            (s.device, np.array(s.data, copy=True))
+            for s in leaf.addressable_shards
+        ]
+
+    def restore(self):
+        arrays = [
+            jax.device_put(jnp_data, device)
+            for device, jnp_data in self.pieces
+        ]
+        return jax.make_array_from_single_device_arrays(
+            self.shape, self.sharding, arrays
+        )
+
+
+def snapshot_state(state):
+    """Host snapshot of a (possibly sharded) device pytree."""
+    return jax.tree_util.tree_map(_LeafSnapshot, state)
+
+
+def restore_state(snap):
+    """Device pytree from a :func:`snapshot_state` capture."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.restore(), snap,
+        is_leaf=lambda x: isinstance(x, _LeafSnapshot),
+    )
+
+
+class SnapshotRing:
+    """Bounded ring of ``(num_updates, dispatch_count, snapshot)``."""
+
+    def __init__(self, size=2):
+        self.size = max(1, int(size))
+        self._ring = collections.deque(maxlen=self.size)
+
+    def __len__(self):
+        return len(self._ring)
+
+    def take(self, state, num_updates, dispatch_count):
+        self._ring.append(
+            (int(num_updates), int(dispatch_count), snapshot_state(state))
+        )
+
+    def latest(self):
+        """Newest entry or None; the snapshot is NOT consumed — repeated
+        rewinds to the same last-good state are legitimate (the policy's
+        abort threshold bounds them)."""
+        if not self._ring:
+            return None
+        return self._ring[-1]
+
+    def clear(self):
+        self._ring.clear()

@@ -1,0 +1,49 @@
+"""Per-update loss-trajectory writer (the chaos harness's evidence).
+
+One JSON line per PROCESSED step — real updates and anomalous skips
+alike — with the loss recorded at full float precision (``repr`` of the
+float64 widening of the f32 device scalar is exact), so two runs can be
+compared BIT-EXACTLY, not just "close".  The file is opened in append
+mode and flushed per line: a SIGKILL mid-run loses at most the line
+being written, and a resumed run appends after the lines the killed run
+already proved."""
+
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+class TrajectoryWriter:
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def record(self, **fields):
+        """Write one step record; floats serialize via repr (exact)."""
+        self._fh.write(json.dumps(fields, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        try:
+            self._fh.close()
+        except OSError:
+            logger.warning("trajectory file close failed", exc_info=True)
+
+
+def read_trajectory(path):
+    """Parse a trajectory file -> list of dicts (torn last line dropped:
+    a SIGKILL mid-write is exactly the case the harness exercises)."""
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                logger.warning("dropping torn trajectory line in %s", path)
+    return records

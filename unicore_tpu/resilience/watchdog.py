@@ -1,0 +1,114 @@
+"""Step watchdog: a hung device step must not hang the run forever.
+
+A wedged ICI link or a deadlocked collective surfaces as a device fetch
+that never returns — no Python exception, no log line, a multi-day run
+silently burning its reservation.  The watchdog is a daemon thread with
+a deadline: the trainer arms it around every blocking device operation
+(dispatch with donated buffers, the stats ``device_get``) and disarms
+on return.  On expiry it dumps every thread's stack (faulthandler) and
+the device memory stats, then runs ``on_timeout`` — by default
+``os._exit(87)``, because a truly hung XLA call holds the GIL-released
+C++ frame and no Python-level interrupt can unwind it; exiting lets the
+supervisor restart from the last checkpoint, which the preemption +
+integrity machinery makes safe."""
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+EXIT_CODE = 87  # distinct from OOM kills / signal deaths for supervisors
+
+
+def _default_timeout_action(phase, timeout):
+    logger.error(
+        "watchdog: device step hung for > %.0fs in %s; dumping stacks "
+        "and exiting %d so the supervisor can restart from the last "
+        "checkpoint", timeout, phase, EXIT_CODE,
+    )
+    try:
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.flush()
+    except Exception:  # unicore-lint: disable=UL107 -- diagnostics must not block the exit
+        pass
+    os._exit(EXIT_CODE)
+
+
+class StepWatchdog:
+    """``with watchdog.armed("train_step/dispatch"): <blocking call>``."""
+
+    def __init__(self, timeout, on_timeout=None):
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout or _default_timeout_action
+        self.fired = False
+        self._phase = None
+        self._deadline = None
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = None
+
+    # -- arming --------------------------------------------------------
+
+    class _Armed:
+        def __init__(self, dog, phase):
+            self.dog = dog
+            self.phase = phase
+
+        def __enter__(self):
+            self.dog._arm(self.phase)
+            return self.dog
+
+        def __exit__(self, *exc):
+            self.dog._disarm()
+            return False
+
+    def armed(self, phase):
+        return self._Armed(self, phase)
+
+    def _arm(self, phase):
+        if self.timeout <= 0:
+            return
+        with self._lock:
+            self._phase = phase
+            self._deadline = time.monotonic() + self.timeout
+        self._ensure_thread()
+        self._wake.set()
+
+    def _disarm(self):
+        with self._lock:
+            self._phase = None
+            self._deadline = None
+
+    # -- the watcher thread --------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._watch, name="unicore-step-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def _watch(self):
+        poll = max(0.05, min(1.0, self.timeout / 4.0))
+        while not self._stop:
+            with self._lock:
+                deadline, phase = self._deadline, self._phase
+            if deadline is not None and time.monotonic() > deadline:
+                self.fired = True
+                self._disarm()
+                self.on_timeout(phase, self.timeout)
+                continue
+            if deadline is None:
+                self._wake.wait(timeout=5.0)
+                self._wake.clear()
+            else:
+                time.sleep(poll)
+
+    def close(self):
+        self._stop = True
+        self._wake.set()

@@ -259,6 +259,52 @@ class Trainer:
             default_scale_window(self.data_parallel_world_size, self.update_freq)
         )
 
+        # ---- fault tolerance (unicore_tpu.resilience) ----------------
+        from unicore_tpu.resilience import (
+            AnomalyGuardConfig,
+            EscalationPolicy,
+            SnapshotRing,
+            StepWatchdog,
+            TrajectoryWriter,
+        )
+
+        self._guard_cfg = AnomalyGuardConfig.from_args(args)
+        self._snapshot_interval = int(
+            getattr(args, "snapshot_interval_updates", 0) or 0
+        )
+        self._snapshot_ring = (
+            SnapshotRing(int(getattr(args, "snapshot_ring_size", 2) or 2))
+            if self._snapshot_interval > 0 else None
+        )
+        self._escalation = EscalationPolicy(
+            self._guard_cfg,
+            has_scaler=self.use_scaler,
+            has_ring=self._snapshot_ring is not None,
+        )
+        self._watchdog = StepWatchdog(
+            float(getattr(args, "step_timeout", 0) or 0)
+        )
+        traj_path = getattr(args, "trajectory_file", None)
+        self._trajectory = TrajectoryWriter(traj_path) if traj_path else None
+        # chaos-only fault injection (the harness's hook into the REAL
+        # jitted step): "nonfinite:K" poisons the grads of dispatch K,
+        # "spike:K" scales the guard's loss stat — both leave the
+        # production program untouched when the env var is unset
+        self._chaos_inject = None
+        import os as _os
+
+        inject = _os.environ.get("UNICORE_TPU_CHAOS_INJECT")
+        if inject:
+            kind, _, at = inject.partition(":")
+            if kind not in ("nonfinite", "spike") or not at.isdigit():
+                raise ValueError(
+                    f"UNICORE_TPU_CHAOS_INJECT={inject!r}: expected "
+                    f"'nonfinite:<dispatch>' or 'spike:<dispatch>'"
+                )
+            self._chaos_inject = (kind, int(at))
+            logger.warning("CHAOS: will inject %s at dispatch %d", kind,
+                           int(at))
+
         metrics.log_start_time("wall", priority=790, round=0)
 
     # ------------------------------------------------------------------
@@ -285,6 +331,11 @@ class Trainer:
             state["scaler"] = scaler_init(
                 float(getattr(self.args, "fp16_init_scale", 2 ** 7))
             )
+        # anomaly-guard scalars ride the TrainState so checkpoints carry
+        # the loss baseline and escalation counters across a resume
+        from unicore_tpu.resilience import guard_init
+
+        state["guard"] = guard_init()
         if self.ema_decay > 0:
             # real copies: aliasing params would break buffer donation
             state["ema"] = jax.tree_util.tree_map(jnp.copy, params)
@@ -309,6 +360,17 @@ class Trainer:
         without ever assembling the full array on any host."""
         state = _map_host_arrays(jnp.asarray, state)
         self._state_shardings = state_sharding(self.mesh, state)
+        # ZeRO compute layout: the step casts master -> compute dtype and
+        # constrains the result to the fsdp-stripped shardings (see
+        # distributed.utils.strip_axis)
+        if self._mesh_shape.get("fsdp", 1) > 1:
+            from unicore_tpu.distributed.utils import strip_axis
+
+            self._compute_param_shardings = strip_axis(
+                self._state_shardings["params"]
+            )
+        else:
+            self._compute_param_shardings = None
 
         def put(path, leaf, sharding):
             if _is_marker(leaf):
@@ -522,6 +584,14 @@ class Trainer:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype), params_f32
             )
+        if getattr(self, "_compute_param_shardings", None) is not None:
+            # fsdp: gather the compute copy once here so the whole
+            # forward/backward runs the clean batch-sharded program
+            # (storage stays ZeRO-sharded; grads reduce-scatter at the
+            # accumulator constraint in the micro loop)
+            params = jax.lax.with_sharding_constraint(
+                params, self._compute_param_shardings
+            )
         loss, sample_size, logging_output = self.task.loss_and_metrics(
             self.model, self.loss, params, batch, rng, is_training=True
         )
@@ -532,6 +602,8 @@ class Trainer:
         )
 
     def _make_train_step(self):
+        from unicore_tpu.resilience import guard_update
+
         clip_norm = self.clip_norm
         use_scaler = self.use_scaler
         ema_decay = self.ema_decay
@@ -539,6 +611,8 @@ class Trainer:
         min_loss_scale = float(getattr(self.args, "min_loss_scale", 1e-4))
         optimizer = self.optimizer
         state_shardings = self._state_shardings
+        guard_cfg = self._guard_cfg
+        chaos_inject = self._chaos_inject
         # fast path (reference trainer.py:973-1055): summable logging
         # outputs accumulate inside the scan; non-summable ones come back
         # stacked per micro-batch and are unpacked host-side
@@ -550,7 +624,7 @@ class Trainer:
                 "(per-example logs are accumulated inside the step)"
             )
 
-        def train_step(state, batches, weights, lr, rng):
+        def train_step(state, batches, weights, lr, rng, inject):
             scale = state["scaler"]["scale"] if use_scaler else jnp.float32(1.0)
 
             def grads_per_sample_clipped(batch, mb_rng, w):
@@ -565,12 +639,12 @@ class Trainer:
                 """
                 def one(carry, xs_ex):
                     example, ex_idx = xs_ex
-                    g_acc, ss_acc, logs_acc = carry
+                    g_acc, ss_acc, l_acc, logs_acc = carry
                     ex = jax.tree_util.tree_map(lambda x: x[None], example)
                     # per-example rng: without the fold_in every example
                     # would draw the identical dropout mask
                     ex_rng = jax.random.fold_in(mb_rng, ex_idx)
-                    (_, (ss_e, logs_e)), g = jax.value_and_grad(
+                    (l_e, (ss_e, logs_e)), g = jax.value_and_grad(
                         self._loss_for_microbatch, has_aux=True
                     )(state["params"], ex, ex_rng, w, scale)
                     # clip threshold applies to the UNSCALED grad norm
@@ -583,7 +657,7 @@ class Trainer:
                     logs_acc = jax.tree_util.tree_map(
                         lambda a, l: a + l, logs_acc, logs_e
                     )
-                    return (g_acc, ss_acc + ss_e, logs_acc), None
+                    return (g_acc, ss_acc + ss_e, l_acc + l_e, logs_acc), None
 
                 z_g = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
@@ -592,24 +666,36 @@ class Trainer:
                     lambda _: jnp.zeros((), jnp.float32), self._logging_proto
                 )
                 n_examples = jax.tree_util.tree_leaves(batch)[0].shape[0]
-                (g, ss, logs), _ = jax.lax.scan(
-                    one, (z_g, jnp.zeros((), jnp.float32), z_l),
+                (g, ss, lsum, logs), _ = jax.lax.scan(
+                    one,
+                    (z_g, jnp.zeros((), jnp.float32),
+                     jnp.zeros((), jnp.float32), z_l),
                     (batch, jnp.arange(n_examples)),
                 )
-                return g, ss, logs
+                return g, ss, lsum, logs
 
             def micro(carry, xs):
-                grads_acc, ss_acc, logs_acc = carry
+                grads_acc, ss_acc, loss_acc, logs_acc = carry
                 batch, w, idx = xs
                 mb_rng = jax.random.fold_in(rng, idx)
                 if psc > 0:
-                    grads, ss, logs = grads_per_sample_clipped(batch, mb_rng, w)
+                    grads, ss, lsum, logs = grads_per_sample_clipped(
+                        batch, mb_rng, w
+                    )
                 else:
-                    (_, (ss, logs)), grads = jax.value_and_grad(
+                    (lsum, (ss, logs)), grads = jax.value_and_grad(
                         self._loss_for_microbatch, has_aux=True
                     )(state["params"], batch, mb_rng, w, scale)
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                )
+                # pin the in-scan accumulator to the param shardings:
+                # without this, sharding propagation is free to invent a
+                # feature-dim fsdp layout for the grad chain, which drags
+                # the layer_norm backward's [B,T,C] row-stat broadcasts
+                # into an involuntary full remat (the fsdp2 UL202 cost)
+                grads_acc = jax.lax.with_sharding_constraint(
+                    grads_acc, state_shardings["params"]
                 )
                 if sum_logs:
                     logs_acc = jax.tree_util.tree_map(
@@ -618,7 +704,7 @@ class Trainer:
                     ys = None
                 else:
                     ys = logs
-                return (grads_acc, ss_acc + ss, logs_acc), ys
+                return (grads_acc, ss_acc + ss, loss_acc + lsum, logs_acc), ys
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
@@ -626,14 +712,15 @@ class Trainer:
             zero_logs = jax.tree_util.tree_map(
                 lambda _: jnp.zeros((), jnp.float32), self._logging_proto
             )
+            zero_f = jnp.zeros((), jnp.float32)
             n_micro = weights.shape[0]
             if n_micro == 1:
                 # no grad accumulation: skip the scan so XLA fuses the
                 # backward straight into clip/update (a 1-iteration scan
                 # still materializes the carry grad tree)
                 one = jax.tree_util.tree_map(lambda x: x[0], batches)
-                (grads, sample_size, summed_logs), ys = micro(
-                    (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
+                (grads, sample_size, loss_sum, summed_logs), ys = micro(
+                    (zero_grads, zero_f, zero_f, zero_logs),
                     (one, weights[0], jnp.int32(0)),
                 )
                 stacked_logs = (
@@ -641,17 +728,29 @@ class Trainer:
                     else jax.tree_util.tree_map(lambda y: y[None], ys)
                 )
             else:
-                (grads, sample_size, summed_logs), stacked_logs = jax.lax.scan(
+                ((grads, sample_size, loss_sum, summed_logs),
+                 stacked_logs) = jax.lax.scan(
                     micro,
-                    (zero_grads, jnp.zeros((), jnp.float32), zero_logs),
+                    (zero_grads, zero_f, zero_f, zero_logs),
                     (batches, weights, jnp.arange(n_micro)),
                 )
             logs = summed_logs if sum_logs else stacked_logs
+
+            if chaos_inject is not None and chaos_inject[0] == "nonfinite":
+                # harness-only grad poisoning (env-gated at TRACE time;
+                # the production program never carries this multiply):
+                # exercises the real overflow->skip path end to end
+                bad = jnp.where(inject > 0, jnp.float32(jnp.nan),
+                                jnp.float32(1.0))
+                grads = jax.tree_util.tree_map(lambda g: g * bad, grads)
 
             # unscale + normalize by the GLOBAL sample size in one multiply
             # (reference: multiply_grads(world/sample_size), trainer.py:695-709)
             denom = jnp.maximum(sample_size, 1.0) * scale
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            # the guard's step-loss statistic: mean loss per sample unit,
+            # unscaled — comparable across steps regardless of loss scale
+            loss_mean = loss_sum / denom
             # ZeRO: constrain grads to the fsdp sharding so XLA emits a
             # reduce-scatter (not all-reduce) and the optimizer update runs
             # on each device's param shard only
@@ -668,20 +767,31 @@ class Trainer:
                 jnp.logical_and(grads_finite(grads), jnp.isfinite(grad_norm))
             )
 
+            # in-loop anomaly guard: fold the step loss into the EMA
+            # baseline and OR the spike verdict into the skip signal
+            # (resilience/anomaly.py; a few scalar flops per update)
+            guard_loss = loss_mean
+            if chaos_inject is not None and chaos_inject[0] == "spike":
+                guard_loss = loss_mean * (1.0 + inject * jnp.float32(1e3))
+            new_guard, anomalous, _spike = guard_update(
+                state["guard"], guard_loss, overflow, guard_cfg
+            )
+
             updates, new_opt_state = optimizer.update(
                 grads, state["opt_state"], state["params"], lr=lr
             )
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p + u, state["params"], updates
             )
-            # overflow-skip as a state bypass (reference trainer.py:755-761).
-            # Applied on every path — including the no-scaler one, where the
-            # host aborts on the overflow stat: with lagged stats one more
-            # step is dispatched before the abort, and without the select it
-            # would compound NaN moments into the params, blinding the
+            # anomaly-skip as a state bypass (reference trainer.py:755-761
+            # overflow skip, widened to loss spikes).  Applied on every
+            # path — including the no-scaler one, where the host aborts on
+            # the overflow stat: with lagged stats one more step is
+            # dispatched before the abort, and without the select it would
+            # compound NaN moments into the params, blinding the
             # NaN-detector re-run (select cost measured within noise on v5e).
             keep = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new, old
+                lambda n, o: jnp.where(anomalous, o, n), new, old
             )
             new_params = keep(new_params, state["params"])
             new_opt_state = keep(new_opt_state, state["opt_state"])
@@ -689,12 +799,31 @@ class Trainer:
             new_state = dict(state)
             new_state["params"] = new_params
             new_state["opt_state"] = new_opt_state
-            new_state["step"] = state["step"] + jnp.where(overflow, 0, 1)
+            new_state["step"] = state["step"] + jnp.where(anomalous, 0, 1)
+            new_state["guard"] = new_guard
             if use_scaler:
-                new_state["scaler"] = scaler_update(
+                # the scaler halves on OVERFLOW only (a finite loss spike
+                # says nothing about fp16 range)...
+                new_scaler = scaler_update(
                     state["scaler"], overflow, scale_window,
                     min_scale=min_loss_scale / 2.0,
                 )
+                if guard_cfg.escalate:
+                    # ...but the escalation ladder's backoff stage halves
+                    # it AGAIN while an anomaly streak persists: one skip
+                    # did not clear the nonfinite source, so drive the
+                    # scale down faster than the one-per-step default
+                    backoff = jnp.logical_and(
+                        jnp.logical_and(anomalous, overflow),
+                        new_guard["streak"] >= guard_cfg.backoff_after,
+                    )
+                    new_scaler = dict(new_scaler)
+                    new_scaler["scale"] = jnp.maximum(
+                        jnp.where(backoff, new_scaler["scale"] * 0.5,
+                                  new_scaler["scale"]),
+                        min_loss_scale / 2.0,
+                    )
+                new_state["scaler"] = new_scaler
             if ema_decay > 0:
                 d = jnp.float32(ema_decay)
                 new_ema = jax.tree_util.tree_map(
@@ -711,6 +840,15 @@ class Trainer:
                 "overflow": overflow.astype(jnp.float32),
                 "loss_scale": scale,
                 "logs": logs,
+                "anomaly": {
+                    "anomalous": anomalous.astype(jnp.float32),
+                    "spike": _spike.astype(jnp.float32),
+                    "streak": new_guard["streak"],
+                    "skips": new_guard["skips"],
+                    "spikes": new_guard["spikes"],
+                    "loss_mean": loss_mean,
+                    "loss_ema": state["guard"]["loss_ema"],
+                },
             }
             return new_state, stats
 
@@ -781,11 +919,17 @@ class Trainer:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.seed), self._dispatch_count
         )
+        dispatch_idx = self._dispatch_count
         self._dispatch_count += 1
+        inject = jnp.float32(
+            1.0 if (self._chaos_inject is not None
+                    and dispatch_idx == self._chaos_inject[1]) else 0.0
+        )
         try:
             with jax.profiler.TraceAnnotation("train_step/dispatch"):
                 self.state, stats = self._dispatch_train_step(
-                    self.state, batches, jnp.asarray(weights_np), lr, rng
+                    self.state, batches, jnp.asarray(weights_np), lr,
+                    rng, inject,
                 )
         except Exception as e:
             # the reference logs cuda memory_summary on step failure
@@ -805,7 +949,9 @@ class Trainer:
                     priority=710, round=2, weight=0,
                 )
 
-        self._pending_stats.append((stats, weights_np, samples[0]))
+        self._pending_stats.append(
+            (stats, weights_np, samples[0], dispatch_idx)
+        )
         out = None
         while len(self._pending_stats) > self.stats_lag:
             out = self._process_stats(*self._pending_stats.pop(0))
@@ -833,7 +979,8 @@ class Trainer:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.seed), self._dispatch_count or 0
         )
-        args = (self.state, batches, jnp.asarray(weights_np), lr, rng)
+        args = (self.state, batches, jnp.asarray(weights_np), lr, rng,
+                jnp.float32(0.0))
         traced = self._jit_train_step.trace(*args)
         return {
             "jaxpr": traced.jaxpr,
@@ -842,7 +989,7 @@ class Trainer:
             "state": self.state,
         }
 
-    def _dispatch_train_step(self, state, batches, weights, lr, rng):
+    def _dispatch_train_step(self, state, batches, weights, lr, rng, inject):
         """AOT-compile the train step (so its ``memory_analysis()`` can be
         checked against HBM BEFORE the first step executes — the §5.3
         ergonomics the reference's OOM catch-log-retry provided,
@@ -854,14 +1001,22 @@ class Trainer:
         )
         if self._compiled_train_step is None or self._compiled_sig != sig:
             lowered = self._jit_train_step.lower(
-                state, batches, weights, lr, rng
+                state, batches, weights, lr, rng, inject
             )
             with jax.profiler.TraceAnnotation("train_step/compile"):
                 compiled = lowered.compile()
             self._preflight_memory_check(compiled)
             self._compiled_train_step = compiled
             self._compiled_sig = sig
-        return self._compiled_train_step(state, batches, weights, lr, rng)
+        # the watchdog arms around EXECUTION only: --step-timeout is
+        # tuned to step time, and a first-step (or resignature) XLA
+        # compile legitimately takes minutes — arming it too would
+        # exit-87 a healthy run into a supervisor crash loop that hits
+        # the identical compile on every restart
+        with self._watchdog.armed("train_step/dispatch"):
+            return self._compiled_train_step(
+                state, batches, weights, lr, rng, inject
+            )
 
     def _preflight_memory_check(self, compiled):
         """Compare the compiled step's memory footprint against device HBM
@@ -948,45 +1103,91 @@ class Trainer:
         ``get_num_updates() + num_pending_updates()``)."""
         return len(self._pending_stats)
 
-    def _process_stats(self, stats, weights_np, first_sample):
+    def _process_stats(self, stats, weights_np, first_sample,
+                       dispatch_idx=None):
         # host-side bookkeeping (one device->host sync per processed step)
         with jax.profiler.TraceAnnotation("train_step/stats-sync"):
-            stats = jax.device_get(stats)
+            with self._watchdog.armed("train_step/stats-sync"):
+                stats = jax.device_get(stats)
         overflow = bool(stats["overflow"] > 0)
-        if overflow:
-            if not self.use_scaler:
-                # fp32/bf16 non-finite grads are a real failure: localize the
-                # first offending module, then abort (reference
-                # trainer.py:733-754 NanDetector re-run)
-                from unicore_tpu.nan_detector import log_nonfinite_modules
+        anom = stats["anomaly"]
+        anomalous = bool(anom["anomalous"] > 0)
+        spike = bool(anom["spike"] > 0)
+        streak = int(anom["streak"])
+        action = self._escalation.decide(anomalous, streak,
+                                         overflow=overflow)
+
+        if anomalous:
+            reason = "non-finite gradients" if overflow else "loss spike"
+            if action == "abort" or (
+                    overflow and not self.use_scaler
+                    and not self._guard_cfg.escalate):
+                # a real failure: localize the first offending module,
+                # then abort (reference trainer.py:733-754 NanDetector
+                # re-run) — the params are CLEAN (the anomaly bypass
+                # never applied the poisoned update), so the re-run sees
+                # the state that produced the bad step
+                from unicore_tpu.nan_detector import (
+                    log_nonfinite_modules,
+                    log_nonfinite_state,
+                )
 
                 try:
                     log_nonfinite_modules(
                         self.model, self.state["params"],
                         self._prepare_sample_host(first_sample),
                     )
+                    # certify the skip bypass kept params + moments clean
+                    log_nonfinite_state(
+                        {"params": self.state["params"],
+                         "opt_state": self.state["opt_state"]},
+                        header="train state",
+                    )
                 except Exception as e:  # detector must never mask the abort
                     logger.warning("NanDetector re-run failed: %s", e)
+                self._record_trajectory(stats, dispatch_idx, action)
+                if action == "abort":
+                    self._escalation.aborts += 1
+                    raise FloatingPointError(
+                        f"anomaly escalation exhausted: {streak} "
+                        f"consecutive anomalous steps ({reason}); see "
+                        f"NanDetector log above."
+                    )
                 raise FloatingPointError(
                     "Non-finite gradients detected (and no fp16 loss scaler "
                     "to absorb them); see NanDetector log above."
                 )
-            scale = float(stats["loss_scale"])
-            if scale <= float(getattr(self.args, "min_loss_scale", 1e-4)):
-                raise FloatingPointError(
-                    f"Minimum loss scale reached ({scale}). "
-                    "Your loss is probably exploding."
-                )
-            logger.info("gradient overflow detected, skipping update")
+            if overflow and self.use_scaler:
+                scale = float(stats["loss_scale"])
+                if scale <= float(getattr(self.args, "min_loss_scale", 1e-4)):
+                    raise FloatingPointError(
+                        f"Minimum loss scale reached ({scale}). "
+                        "Your loss is probably exploding."
+                    )
+            logger.info(
+                "%s detected (streak %d), %s",
+                reason, streak,
+                {"skip": "skipping update",
+                 "backoff": "skipping update + loss-scale backoff",
+                 "rewind": "rewinding to last-good snapshot"}[action],
+            )
             metrics.log_scalar("n_skipped", 1, priority=600, round=0)
+            metrics.log_scalar(f"anomaly_{action}", 1, priority=610, round=0)
+            if spike:
+                metrics.log_scalar("loss_spikes", 1, priority=620, round=0)
+            self._record_trajectory(stats, dispatch_idx, action)
+            if action == "rewind":
+                self._rewind_to_snapshot()
         else:
             self.set_num_updates(self.get_num_updates() + 1)
+            self._record_trajectory(stats, dispatch_idx, "none")
+            self._maybe_snapshot()
 
         logging_outputs = self._unpack_logging_outputs(
             stats["logs"], weights_np, is_train=True
         )
         sample_size = float(stats["sample_size"])
-        if not overflow:
+        if not anomalous:
             self._reduce_and_log_stats(
                 logging_outputs, sample_size, float(stats["grad_norm"])
             )
@@ -995,6 +1196,83 @@ class Trainer:
                 "loss_scale", float(stats["loss_scale"]), priority=700, round=4
             )
         return logging_outputs
+
+    # ------------------------------------------------------------------
+    # resilience: trajectory, snapshot ring, rewind
+    # ------------------------------------------------------------------
+
+    def _record_trajectory(self, stats, dispatch_idx, action):
+        if self._trajectory is None:
+            return
+        anom = stats["anomaly"]
+        self._trajectory.record(
+            update=self.get_num_updates(),
+            dispatch=dispatch_idx,
+            loss=float(anom["loss_mean"]),
+            grad_norm=float(stats["grad_norm"]),
+            skipped=bool(anom["anomalous"] > 0),
+            action=action,
+            streak=int(anom["streak"]),
+        )
+
+    def _maybe_snapshot(self):
+        """Host copy of the live state every ``--snapshot-interval-updates``
+        clean updates (the rewind ladder's last-good ring)."""
+        if self._snapshot_ring is None:
+            return
+        updates = self.get_num_updates()
+        if updates > 0 and updates % self._snapshot_interval == 0:
+            with jax.profiler.TraceAnnotation("train_step/snapshot"):
+                self._snapshot_ring.take(
+                    self.state, updates, self._dispatch_count or 0
+                )
+            logger.info(
+                "anomaly guard: took last-good snapshot @ %d updates "
+                "(ring holds %d)", updates, len(self._snapshot_ring),
+            )
+
+    def _rewind_to_snapshot(self):
+        """Escalation stage 3: reinstall the newest last-good snapshot.
+
+        In-flight lagged stats belong to steps computed from the
+        abandoned state chain and are DROPPED unprocessed; the dispatch
+        counter keeps advancing so the replayed steps draw fresh dropout
+        streams instead of re-living the exact batch/noise combination
+        that blew up.  The anomaly STREAK (and the skip/spike totals)
+        carry over from the live guard rather than the snapshot's —
+        the snapshot was taken on a clean step with streak 0, and
+        restoring that would make a persistent fault loop
+        skip->rewind->skip->rewind forever with the abort rung
+        unreachable; carrying the streak keeps ``--anomaly-abort-after``
+        a real bound on consecutive anomalies across rewinds."""
+        entry = self._snapshot_ring.latest() if self._snapshot_ring else None
+        if entry is None:  # decide() guarantees has_ring, but stay safe
+            raise FloatingPointError(
+                "anomaly escalation reached the rewind stage with no "
+                "snapshot available (raise --snapshot-interval-updates "
+                "frequency or --anomaly-abort-after)"
+            )
+        snap_updates, _snap_dispatch, snap = entry
+        from unicore_tpu.resilience import restore_state
+
+        live_guard = jax.device_get(self.state["guard"])
+        self._pending_stats.clear()
+        self.state = restore_state(snap)
+        for key in ("streak", "skips", "spikes"):
+            leaf = self.state["guard"][key]
+            self.state["guard"][key] = jax.device_put(
+                jnp.asarray(live_guard[key], leaf.dtype), leaf.sharding
+            )
+        restored = int(jax.device_get(self.state["step"]))
+        self.set_num_updates(restored)
+        self._escalation.rewinds += 1
+        metrics.log_scalar("anomaly_rewind_updates", 1, priority=630, round=0)
+        logger.warning(
+            "anomaly guard: rewound to last-good snapshot @ %d updates "
+            "(ring snapshot taken @ %d, anomaly streak %d carried); "
+            "continuing with fresh batches",
+            restored, snap_updates, int(live_guard["streak"]),
+        )
 
     def valid_step(self, sample):
         # NOTE: does NOT flush lagged train stats — _process_stats logs
@@ -1209,6 +1487,14 @@ class Trainer:
     def cumulative_training_time(self):
         return time.time() - self._start_time + self._previous_training_time
 
+    def close(self):
+        """Release resilience resources (trajectory file handle, watchdog
+        thread); the trainer stays usable for state inspection."""
+        if self._trajectory is not None:
+            self._trajectory.close()
+            self._trajectory = None
+        self._watchdog.close()
+
     def _set_seed_noop(self):
         # RNG scoping is explicit fold_in chains; nothing stateful to seed.
         pass
@@ -1375,6 +1661,11 @@ class Trainer:
                     if self.lr_scheduler
                     else {},
                     "num_updates": self.get_num_updates(),
+                    # the dropout-stream counter: num_updates does NOT
+                    # advance on anomaly skips but the stream does, so a
+                    # bit-exact resume needs the dispatch count restored
+                    # verbatim (chaos harness oracle-equality contract)
+                    "dispatch_count": self._dispatch_count,
                 }
             ],
             "task_state": self.task.state_dict(),
@@ -1457,6 +1748,11 @@ class Trainer:
                 )
             if not reset_optimizer:
                 self.set_num_updates(last_optim_state.get("num_updates", 0))
+                # restore the dropout-stream counter exactly (None in
+                # pre-resilience checkpoints -> re-derive from updates)
+                self._dispatch_count = last_optim_state.get(
+                    "dispatch_count", None
+                )
             self.task.load_state_dict(state.get("task_state", {}))
             extra_state = state.get("extra_state", {})
             if not reset_meters and "metrics" in (extra_state or {}):

@@ -56,11 +56,13 @@ def _annotate_iter(iterable, name):
 class TrainLoop:
     """Drives epochs: train, validate, checkpoint, decide when to stop."""
 
-    def __init__(self, args, trainer, task, ckpt: CheckpointManager):
+    def __init__(self, args, trainer, task, ckpt: CheckpointManager,
+                 shutdown=None):
         self.args = args
         self.trainer = trainer
         self.task = task
         self.ckpt = ckpt
+        self.shutdown = shutdown  # resilience.GracefulShutdown (or None)
         self.valid_subsets = args.valid_subset.split(",")
         # patience tracking (reference should_stop_early, train.py:147-172)
         self._runs_without_improvement = 0
@@ -189,6 +191,16 @@ class TrainLoop:
 
     def validate_and_save(self, epoch_itr, end_of_epoch):
         args = self.args
+        # preemption (SIGTERM/SIGINT): flush the lagged pipeline so the
+        # checkpoint carries exact counts, write it, and stop — the save
+        # rides the normal do_save=stop path below; validation is skipped
+        # because the grace window is for persisting state, not metrics
+        preempted = self.shutdown is not None and self.shutdown.requested
+        if preempted:
+            logger.warning(
+                "preemption: checkpointing and exiting at this step boundary"
+            )
+            self.trainer.flush_stats()
         # lagged-stats pipeline: flush when this round could owe an action
         # (interval conditions are evaluated on the exact processed count;
         # checkpoints/validation need exact meters) — in the common
@@ -209,7 +221,7 @@ class TrainLoop:
             self.trainer.flush_stats()
             opt_updates = self.trainer.get_num_updates()
         updates = self.trainer.get_num_updates()
-        stop = self._hit_hard_limits()
+        stop = self._hit_hard_limits() or preempted
 
         # what this round owes: a checkpoint, a validation pass, both, or
         # neither (reference validate_and_save condition trees,
@@ -227,7 +239,7 @@ class TrainLoop:
             and opt_updates % args.save_interval_updates == 0
             and updates >= args.validate_after_updates
         )
-        validate_now = not args.disable_validation and (
+        validate_now = not args.disable_validation and not preempted and (
             stop
             or (not end_of_epoch and save_now)
             or (
@@ -361,13 +373,32 @@ def main(args) -> None:
     ckpt = CheckpointManager(args, is_master)
     extra_state, epoch_itr = ckpt.restore(trainer, disable_iterator_cache=False)
 
+    shutdown = None
+    if not getattr(args, "no_graceful_shutdown", False):
+        from unicore_tpu.resilience import GracefulShutdown
+
+        shutdown = GracefulShutdown().install()
+
     import time
     started = time.perf_counter()
-    loop = TrainLoop(args, trainer, task, ckpt)
+    loop = TrainLoop(args, trainer, task, ckpt, shutdown=shutdown)
     try:
         loop.run(epoch_itr)
     finally:
+        # order matters: the checkpoint worker drains BEFORE the process
+        # exits (a preemption save must land on disk), then the trainer
+        # releases its trajectory/watchdog resources
         ckpt.close()
+        trainer.close()
+        if hasattr(epoch_itr, "close"):
+            epoch_itr.close()
+        if shutdown is not None:
+            shutdown.uninstall()
+    if shutdown is not None and shutdown.requested:
+        logger.warning(
+            "exiting after preemption checkpoint (%s)",
+            "SIGTERM" if shutdown.signum == 15 else str(shutdown.signum),
+        )
     logger.info("done training in %.1f seconds", time.perf_counter() - started)
 
 
