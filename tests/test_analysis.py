@@ -652,6 +652,80 @@ def test_swallowed_io_error_inline_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL108 sync-in-step-loop
+# ---------------------------------------------------------------------
+
+def test_sync_in_step_loop_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "loop.py", """
+        import jax
+        def train(trainer, batches):
+            for b in batches:
+                out = trainer.train_step(b)
+                stats = jax.device_get(out)           # per-step sync
+                trainer.save_checkpoint("last.pt", {})  # sync save
+            return stats
+        def drive(trainer, stream):
+            staged = next(stream, None)
+            while staged is not None:
+                out = trainer.train_step(staged)
+                out.block_until_ready()
+                staged = next(stream, None)
+    """)
+    assert sum(1 for f in found if f.rule == "UL108") == 3
+
+
+def test_sync_in_step_loop_silent_outside_and_in_plain_loops(tmp_path):
+    found = _lint_snippet(tmp_path, "loop.py", """
+        import jax
+        def train(trainer, batches):
+            # the sanctioned shape: dispatch inside, fetch at the end
+            for b in batches:
+                out = trainer.train_step(b)
+            trainer.flush_stats()
+            return jax.device_get(out)
+        def not_a_step_loop(xs):
+            # device_get in a loop that never dispatches train steps
+            return [jax.device_get(x) for x in xs]
+        def eval_loop(model, batches):
+            for b in batches:
+                out = model.valid_step(b)
+                host = jax.device_get(out)
+            return host
+        def epochs(trainer, loader):
+            # the OUTER loop is not a step loop: train_step only runs
+            # in the nested loop, so the per-epoch fetch is the
+            # sanctioned real-boundary sync, not a per-step stall
+            for epoch in range(3):
+                for b in loader:
+                    out = trainer.train_step(b)
+                stats = jax.device_get(out)
+                trainer.save_checkpoint(f"ck{epoch}.pt", stats)
+    """)
+    assert "UL108" not in rules_of(found)
+
+
+def test_sync_in_step_loop_inline_suppression_and_closure(tmp_path):
+    found = _lint_snippet(tmp_path, "loop.py", """
+        import jax
+        def train(trainer, batches):
+            for b in batches:
+                out = trainer.train_step(b)
+                x = jax.device_get(out)  # unicore-lint: disable=UL108
+        def builder(trainer):
+            # a closure DEFINED in a step loop does not run per
+            # iteration — its body must not be flagged
+            hooks = []
+            for phase in ("a", "b"):
+                trainer.train_step(None)
+                def done(out):
+                    return jax.device_get(out)
+                hooks.append(done)
+            return hooks
+    """)
+    assert "UL108" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # Pass 3: HLO parsing primitives (pure text, no compile)
 # ---------------------------------------------------------------------
 

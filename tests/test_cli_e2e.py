@@ -58,6 +58,9 @@ def _run_cli(data_dir, save_dir, max_update):
     )
 
 
+@pytest.mark.slow  # ~38s of subprocess compile; tier-1 keeps the
+# in-process resume contracts (test_resilience: bit-exact resume,
+# manager restore) and CI's full suite + chaos legs run this one
 def test_cli_train_and_resume(corpus, tmp_path):
     save_dir = str(tmp_path / "ckpt")
     r = _run_cli(corpus, save_dir, max_update=6)

@@ -58,6 +58,8 @@ def test_evoformer_cli_trains_and_loss_decreases(corpus, tmp_path):
     assert len(losses) >= 2 and losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # ~49s of subprocess compile; tier-1 keeps the plain
+# evoformer CLI run plus the structure-module unit tests
 def test_evoformer_with_structure_module_trains(corpus, tmp_path):
     """North-star configs[2] end-to-end: Evoformer + STRUCTURE MODULE —
     distances come from the pairwise norms of the predicted C-alpha

@@ -552,6 +552,39 @@ class _StubTrainer:
         pass
 
 
+class _SaveItr:
+    """Save-side epoch_itr stand-in for CheckpointManager.save."""
+
+    epoch = 1
+
+    def end_of_epoch(self):
+        return False
+
+    def state_dict(self):
+        return {"epoch": 1}
+
+
+def _saver_trainer(w):
+    """A _StubTrainer that also owns a saveable state (``w``: the params
+    payload) — the save-side half of the CheckpointManager contract."""
+
+    class _SaverTrainer(_StubTrainer):
+        is_data_parallel_master = True
+
+        def get_num_updates(self):
+            return 3
+
+        def collect_checkpoint_state(self, extra_state):
+            sd = {
+                "model": {"params": {"w": w}},
+                "optimizer_history": [{"num_updates": 3}],
+                "extra_state": dict(extra_state),
+            }
+            return sd, []
+
+    return _SaverTrainer()
+
+
 def _write_round(save_dir, updates, names):
     payload = {
         "model": {"params": {"w": np.arange(updates, dtype=np.float32)}},
@@ -695,6 +728,205 @@ def test_missing_shard_sidecar_in_integrity_round_is_torn(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# async checkpoint writer (unit tier: the end-to-end crash-window proof
+# is the chaos harness's kill-during-background-write legs)
+# ---------------------------------------------------------------------
+
+def test_writer_bounded_queue_backpressure():
+    """submit() BLOCKS once max_queue jobs are in flight — a slow disk
+    stalls the step path instead of piling state copies up in host
+    memory — and the wait is counted."""
+    import threading
+
+    from unicore_tpu.resilience import AsyncCheckpointWriter
+
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_queue=2)
+    w.submit(gate.wait, label="job0")   # occupies the worker
+    w.submit(lambda: None, label="job1")  # fills the queue
+    t0 = time.monotonic()
+    release = threading.Timer(0.25, gate.set)
+    release.start()
+    try:
+        waited = w.submit(lambda: None, label="job2")  # must block
+    finally:
+        gate.set()
+        release.cancel()
+    assert waited >= 0.1, f"submit returned in {waited:.3f}s — no backpressure"
+    assert time.monotonic() - t0 >= 0.1
+    assert w.stats["backpressure_waits"] == 1
+    w.close(drain=True)
+    assert w.stats["completed"] == 3
+
+
+def test_writer_failure_surfaces_at_next_poll_not_swallowed():
+    """A failed background write re-raises on the MAIN thread at the
+    next poll() — never silently (UL107's contract for the async
+    path) — and later polls stay clean once surfaced."""
+    from unicore_tpu.resilience import (
+        AsyncCheckpointWriter,
+        CheckpointWriteError,
+    )
+
+    w = AsyncCheckpointWriter(max_queue=2)
+
+    def boom():
+        raise OSError("disk on fire")
+
+    w.submit(boom, label="checkpoint_1_3.pt")
+    w.drain()
+    with pytest.raises(CheckpointWriteError, match="checkpoint_1_3.pt"):
+        w.poll()
+    w.poll()  # surfaced once; the queue is clean again
+    w.submit(lambda: None, label="ok")
+    w.close(drain=True)
+    w.poll()
+    assert w.stats["failed"] == 1 and w.stats["completed"] == 1
+
+
+def test_writer_drain_on_close_lands_queued_saves_in_order():
+    """close(drain=True) — the preemption exit-0 gate — blocks until
+    every submitted job has landed, in FIFO order."""
+    from unicore_tpu.resilience import AsyncCheckpointWriter
+
+    landed = []
+    w = AsyncCheckpointWriter(max_queue=4)
+    for i in range(4):
+        w.submit(lambda i=i: (time.sleep(0.02), landed.append(i)),
+                 label=f"job{i}")
+    w.close(drain=True, raise_on_failure=True)
+    assert landed == [0, 1, 2, 3]
+    assert w.in_flight() == 0
+    with pytest.raises(RuntimeError):
+        w.submit(lambda: None)  # closed writers refuse new work
+
+
+def test_writer_capture_ownership_and_wait_released():
+    """owns()/wait_released(): the rewind interlock — a snapshot the
+    writer is still serializing must not be reinstalled (and then
+    donated) until its job lands."""
+    import threading
+
+    from unicore_tpu.resilience import AsyncCheckpointWriter
+
+    capture = {"params": np.zeros(4)}
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_queue=2)
+    w.submit(gate.wait, label="hold", owned=(capture,))
+    assert w.owns(capture)
+    release = threading.Timer(0.15, gate.set)
+    release.start()
+    waited = w.wait_released(capture, timeout=5.0)
+    assert not w.owns(capture)
+    assert waited >= 0.05
+    w.close(drain=True)
+    # unknown objects are never owned
+    assert not w.owns(object())
+
+
+def test_writer_wait_released_times_out():
+    import threading
+
+    from unicore_tpu.resilience import AsyncCheckpointWriter
+
+    capture = object()
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_queue=1)
+    w.submit(gate.wait, label="hold", owned=(capture,))
+    with pytest.raises(TimeoutError):
+        w.wait_released(capture, timeout=0.1)
+    gate.set()
+    w.close(drain=True)
+
+
+def test_trainer_rewind_drains_inflight_writer(rng, monkeypatch):
+    """The anomaly-guard rewind must serialize against an in-flight
+    background save: reinstalling (then donating) host buffers the
+    writer still reads would rot the checkpoint mid-pickle."""
+    import threading
+
+    from unicore_tpu.resilience import AsyncCheckpointWriter
+
+    trainer = make_trainer(
+        anomaly_guard=True, snapshot_interval_updates=1,
+        snapshot_ring_size=2,
+    )
+    batch = make_batch(rng)
+    with metrics.aggregate("train"):
+        for _ in range(3):
+            trainer.train_step([batch])
+    trainer.flush_stats()
+    assert len(trainer._snapshot_ring) > 0
+
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_queue=2)
+    trainer.attach_checkpoint_writer(w)
+    w.submit(gate.wait, label="inflight")
+    release = threading.Timer(0.2, gate.set)
+    release.start()
+    t0 = time.monotonic()
+    with metrics.aggregate("train"):
+        trainer._rewind_to_snapshot()   # must block on the writer first
+    assert time.monotonic() - t0 >= 0.1, "rewind did not wait for the writer"
+    assert w.in_flight() == 0
+    w.close(drain=True)
+    trainer.close()
+
+
+def test_manager_async_save_failure_raises_on_poll(tmp_path, monkeypatch):
+    """CheckpointManager end to end: a background write that fails
+    surfaces from poll() (the train loop's step-boundary call), and the
+    sync fallback (--async-save off) raises inline from save()."""
+    from unicore_tpu.resilience import CheckpointWriteError
+
+    def fail_write(*a, **kw):
+        raise OSError("injected write failure")
+
+    monkeypatch.setattr(checkpoint_utils, "write_checkpoint", fail_write)
+
+    args = _manager_args(tmp_path, save_interval_updates=3,
+                         async_save="on", save_queue_size=2,
+                         no_epoch_checkpoints=True)
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    mgr.save(_saver_trainer(np.zeros(2, np.float32)), _SaveItr(), None,
+             do_save=True)
+    mgr.writer.drain()
+    with pytest.raises(CheckpointWriteError):
+        mgr.poll()
+    mgr.close()
+
+    args_sync = _manager_args(tmp_path, save_interval_updates=3,
+                              async_save="off",
+                              no_epoch_checkpoints=True,
+                              save_dir=str(tmp_path / "save2"),
+                              tmp_save_dir=str(tmp_path / "scratch2"))
+    mgr = checkpoint_utils.CheckpointManager(args_sync, is_master=True)
+    assert mgr.writer is None
+    with pytest.raises(OSError):
+        mgr.save(_saver_trainer(np.zeros(2, np.float32)), _SaveItr(),
+                 None, do_save=True)
+    mgr.close()
+
+
+def test_manager_async_save_lands_and_restores(tmp_path):
+    """The happy path: an async save streams to its final names (data +
+    .sum marker) after drain, and restore() loads it."""
+    args = _manager_args(tmp_path, save_interval_updates=3,
+                         async_save="on", no_epoch_checkpoints=True)
+    mgr = checkpoint_utils.CheckpointManager(args, is_master=True)
+    mgr.save(_saver_trainer(np.arange(2, dtype=np.float32)), _SaveItr(),
+             None, do_save=True)
+    mgr.drain()  # the exit-0 gate: blocks until the files land, raises on failure
+    last = os.path.join(args.save_dir, "checkpoint_last.pt")
+    assert os.path.exists(last) and os.path.exists(last + ".sum")
+    assert checkpoint_utils.file_integrity(last) == "ok"
+    trainer = _StubTrainer()
+    extra, _ = mgr.restore(trainer)
+    assert trainer.loaded_path.endswith("checkpoint_last.pt")
+    mgr.close()
+
+
+# ---------------------------------------------------------------------
 # chaos harness (slow: full subprocess training runs; CI runs the tool
 # directly with the corrupt + inject legs)
 # ---------------------------------------------------------------------
@@ -706,5 +938,48 @@ def test_chaos_harness_sigkill_resume(tmp_path):
     rc = chaos.main([
         "--workdir", str(tmp_path / "chaos"), "--max-update", "8",
         "--save-interval-updates", "3", "--keep",
+    ])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_chaos_harness_kill_during_background_write(tmp_path):
+    """SIGKILL lands between the data copy and the .sum copy of an
+    in-flight BACKGROUND write: the stale-marker checkpoint_last must be
+    discriminated as torn and resume must fall back bit-exactly."""
+    import tools.unicore_chaos as chaos
+
+    rc = chaos.main([
+        "--workdir", str(tmp_path / "chaos"), "--max-update", "10",
+        "--save-interval-updates", "3", "--kill-in-write", "--keep",
+    ])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_chaos_harness_sigterm_during_background_write(tmp_path):
+    """SIGTERM while the writer holds an in-flight save: graceful
+    shutdown must drain it (exit 0, every file intact) and the resume
+    must be bit-exact."""
+    import tools.unicore_chaos as chaos
+
+    rc = chaos.main([
+        "--workdir", str(tmp_path / "chaos"), "--max-update", "10",
+        "--save-interval-updates", "3", "--kill-in-write", "--graceful",
+        "--keep",
+    ])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_chaos_harness_writer_io_failure(tmp_path):
+    """An injected IO failure in a background write must bring the run
+    down loudly (CheckpointWriteError at the next step boundary) and the
+    resume from the last intact checkpoint must be bit-exact."""
+    import tools.unicore_chaos as chaos
+
+    rc = chaos.main([
+        "--workdir", str(tmp_path / "chaos"), "--max-update", "10",
+        "--save-interval-updates", "3", "--writer-fail", "2", "--keep",
     ])
     assert rc == 0

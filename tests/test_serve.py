@@ -189,6 +189,9 @@ def test_ragged_kernel_matches_eager(rng, pages_per_block):
 # -- engine batched correctness (the PR acceptance property) ---------------
 
 
+@pytest.mark.slow  # ~67s (8 solo-oracle full forwards); tier-1 keeps
+# the scheduler property traces, parity, and pool-exhausted recovery
+# tests; CI's full suite + serve smoke run this acceptance oracle
 def test_engine_mixed_batch_matches_solo_decode(lm, rng):
     """>= 8 requests, mixed prompt lengths, pool sized to force
     eviction at least once: every emitted sequence must be
@@ -220,6 +223,90 @@ def test_engine_mixed_batch_matches_solo_decode(lm, rng):
         assert res.finish_reason in ("eos", "length")
         assert res.ttft_ms >= 0.0
     assert engine.stats["peak_pool_occupancy"] > 0.5
+
+
+def test_scheduler_admit_race_returns_partial_or_reraises_empty():
+    """The accounting race inside admit() (can_alloc said yes,
+    alloc raised anyway): with earlier admissions in the same call the
+    partial batch is RETURNED (so the engine prefills them — an escape
+    would strand allocated-but-never-prefilled KV pages in `running`),
+    and only an empty admission re-raises for the engine's recovery.
+    Either way the raced sequence stays at waiting[0]."""
+    from unicore_tpu.serve.scheduler import Scheduler
+
+    pool = PagedKVPool(num_pages=16, page_size=4)
+    sched = Scheduler(pool, max_batch=4, prefill_token_budget=64)
+    for i in range(3):
+        sched.add(Request(prompt=[1] * 6, max_new_tokens=2,
+                          seed=i, request_id=f"r{i}"))
+    real_can_alloc, lies = pool.can_alloc, {"calls": 0}
+
+    def lie_on_second(n):  # 2nd admission's alloc hits the race
+        lies["calls"] += 1
+        return True if lies["calls"] == 2 else real_can_alloc(n)
+
+    real_alloc = pool.alloc
+
+    def alloc(sid, n):
+        if lies["calls"] == 2 and not real_can_alloc(n):
+            raise PoolExhausted("raced")
+        return real_alloc(sid, n)
+
+    pool.can_alloc, pool.alloc = lie_on_second, alloc
+    del pool._free[:-2]  # 2 free pages left: fits ONE 6-token prompt
+    admitted = sched.admit()
+    assert [s.req.request_id for s in admitted] == ["r0"], admitted
+    assert sched.waiting[0].req.request_id == "r1", "raced seq lost"
+    assert [s.req.request_id for s in sched.running] == ["r0"]
+    # empty admission: the race now escapes (the engine's recovery path)
+    lies["calls"] = 1  # next can_alloc call lies again
+    with pytest.raises(PoolExhausted):
+        sched.admit()
+    assert sched.waiting[0].req.request_id == "r1", "raced seq lost"
+    assert [s.req.request_id for s in sched.running] == ["r0"]
+
+
+def test_engine_recovers_from_pool_exhausted_admission_race(lm):
+    """A PoolExhausted that escapes admit() (which, per the scheduler
+    contract above, means NOTHING was admitted in that call) must not
+    escape the engine: it preempts the scheduler's LIFO victim, counts
+    ``pool_exhausted_recoveries``, re-admits the still-queued sequence,
+    and every request's tokens remain identical to solo decode — the
+    race is a capacity hiccup, never an accuracy or liveness event."""
+    model, params = lm
+    engine = ServeEngine(
+        model, params, num_pages=7, page_size=4, max_batch=3,
+        prefill_token_budget=16,
+        chaos_rate=0.2, chaos_rng=random.Random(3),
+    )
+    sched = engine.scheduler
+    real_admit, races = sched.admit, {"n": 0}
+
+    def racing_admit(bucket=None):
+        # the empty-admission escape, mid-run (a victim must exist)
+        if races["n"] < 2 and sched.running and sched.waiting:
+            races["n"] += 1
+            raise PoolExhausted("admission race")
+        return real_admit(bucket=bucket)
+
+    sched.admit = racing_admit
+    trng = np.random.RandomState(3)
+    reqs = [
+        Request(
+            prompt=trng.randint(1, V, size=(int(n),)).tolist(),
+            max_new_tokens=5, seed=i, eos_id=5, request_id=f"r{i}",
+        )
+        for i, n in enumerate([3, 7, 5, 8, 4])
+    ]
+    results = engine.generate(reqs)
+    assert races["n"] == 2, "the race was never exercised"
+    assert engine.stats["pool_exhausted_recoveries"] >= 1
+    engine.pool.check_invariants()
+    assert [r.request_id for r in results] == [f"r{i}" for i in range(5)]
+    for res, req in zip(results, reqs):
+        want = solo_greedy(model, params, req.prompt, req.max_new_tokens,
+                           eos=req.eos_id)
+        assert res.tokens == want, (req.prompt, res.tokens, want)
 
 
 @pytest.mark.parametrize("chaos_seed", [11, 23])

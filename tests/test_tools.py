@@ -64,6 +64,8 @@ def test_convert_cli_entry(tmp_path):
     assert os.path.exists(dst)
 
 
+@pytest.mark.slow  # ~49s of subprocess compile for a flag smoke; CI's
+# full suite still runs it
 def test_profile_flag_captures_trace(tmp_path):
     """--profile wraps the run in jax.profiler.trace: an xplane/perfetto
     trace must exist under save_dir/jax_trace after a short CLI run."""

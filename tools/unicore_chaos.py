@@ -139,10 +139,12 @@ def run_to_completion(cmd, env, timeout=900):
     return proc.stdout + proc.stderr
 
 
-def run_and_kill(cmd, env, traj_file, kill_at_lines, *, graceful,
+def run_and_kill(cmd, env, traj_file, *, graceful, trigger, desc,
                  timeout=900):
-    """Start a run and SIGKILL (or SIGTERM) it once the trajectory shows
-    ``kill_at_lines`` processed steps.  Returns (captured output, killed)."""
+    """Start a run and SIGKILL (or SIGTERM) it once ``trigger()`` is
+    true — either a trajectory line count, or (for the background-write
+    legs) the sentinel file the writer touches inside its
+    data->marker crash window.  Returns (captured output, killed)."""
     with open(traj_file + ".victim.log", "w") as log:
         proc = subprocess.Popen(
             cmd, env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
@@ -154,7 +156,7 @@ def run_and_kill(cmd, env, traj_file, kill_at_lines, *, graceful,
             if time.monotonic() > deadline:
                 proc.kill()
                 raise RuntimeError("victim run timed out before the kill")
-            if traj_lines(traj_file) >= kill_at_lines:
+            if trigger():
                 if graceful:
                     proc.send_signal(signal.SIGTERM)
                     rc = proc.wait(timeout=300)
@@ -170,12 +172,36 @@ def run_and_kill(cmd, env, traj_file, kill_at_lines, *, graceful,
             time.sleep(0.05)
     with open(traj_file + ".victim.log", encoding="utf-8") as f:
         out = f.read()
-    if not killed and traj_lines(traj_file) < kill_at_lines:
+    if not killed:
         raise RuntimeError(
-            f"run finished before reaching {kill_at_lines} steps:\n"
+            f"run finished before the kill trigger ({desc}) fired:\n"
             f"{out[-3000:]}"
         )
     return out, killed
+
+
+def run_expect_write_failure(cmd, env, timeout=900):
+    """Run a victim whose checkpoint writer has an injected IO failure
+    (UNICORE_TPU_CHAOS_WRITE_FAIL): the run must DIE NON-ZERO with a
+    CheckpointWriteError surfaced at a step boundary — a background
+    write failure silently swallowed (exit 0, or a clean 'done
+    training') is exactly the bug this leg exists to catch."""
+    proc = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    out = proc.stdout + proc.stderr
+    if proc.returncode == 0:
+        raise RuntimeError(
+            "writer-IO-failure leg: the run exited 0 despite a failed "
+            "background checkpoint write (swallowed IO):\n" + out[-3000:]
+        )
+    if "CheckpointWriteError" not in out:
+        raise RuntimeError(
+            f"writer-IO-failure leg: run died rc={proc.returncode} but "
+            f"not via CheckpointWriteError:\n" + out[-3000:]
+        )
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -292,6 +318,22 @@ def build_parser():
     p.add_argument("--graceful", action="store_true",
                    help="SIGTERM instead of SIGKILL: also asserts the "
                         "preemption checkpoint-and-exit path returns 0")
+    p.add_argument("--kill-in-write", action="store_true",
+                   help="land the kill INSIDE the background writer's "
+                        "data->marker finalize window of checkpoint_last "
+                        "(UNICORE_TPU_CHAOS_WRITE_HOLD sentinel): the "
+                        "torn-round discrimination must reject the "
+                        "believable data file with its stale marker and "
+                        "fall back to the newest intact checkpoint; "
+                        "combine with --graceful for the SIGTERM-during-"
+                        "background-write drain-and-exit-0 leg")
+    p.add_argument("--writer-fail", type=int, default=0, metavar="K",
+                   help="inject an IO failure into the victim's K-th "
+                        "checkpoint write (UNICORE_TPU_CHAOS_WRITE_FAIL): "
+                        "the run must die non-zero via CheckpointWriteError "
+                        "at the next step boundary (no swallowed IO), and "
+                        "the resume must be bit-exact from the last intact "
+                        "checkpoint")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")
@@ -307,6 +349,11 @@ def main(argv=None):
 
     from unicore_tpu.resilience import read_trajectory
 
+    if args.writer_fail and args.graceful:
+        raise SystemExit(
+            "--writer-fail and --graceful are exclusive: the injected IO "
+            "failure must bring the run down by itself"
+        )
     workdir = args.workdir or tempfile.mkdtemp(prefix="unicore_chaos_")
     os.makedirs(workdir, exist_ok=True)
     rng = random.Random(args.seed)
@@ -317,6 +364,8 @@ def main(argv=None):
         "corrupt": args.corrupt, "inject": args.inject,
         "graceful": bool(args.graceful), "kills": [], "torn_files": [],
         "fallback_used": False,
+        "kill_in_write": bool(args.kill_in_write),
+        "writer_fail": int(args.writer_fail),
     }
 
     # -- oracle ---------------------------------------------------------
@@ -336,20 +385,56 @@ def main(argv=None):
     save_dir = os.path.join(workdir, "chaos_ckpt")
     cmd = train_cmd(args, data_dir, save_dir, chaos_traj)
     for cycle in range(args.kills):
-        # a corrupt leg tears the whole newest round, so at least TWO
-        # rounds must be on disk before the kill or there is nothing
-        # intact to fall back to
-        rounds_needed = 2 if args.corrupt != "none" else 1
-        lo = rounds_needed * args.save_interval_updates + 1
-        hi = max(lo + 1, args.max_update - 1)
-        kill_at = rng.randrange(lo, hi)
-        already = traj_lines(chaos_traj)
-        print(f"[chaos] cycle {cycle}: kill after {kill_at} new steps "
-              f"({'SIGTERM' if args.graceful else 'SIGKILL'})", flush=True)
-        out, _ = run_and_kill(
-            cmd, env, chaos_traj, already + kill_at, graceful=args.graceful,
-        )
-        report["kills"].append({"cycle": cycle, "kill_at": kill_at})
+        if args.writer_fail:
+            # writer-IO-failure leg: no kill — the injected failure must
+            # bring the run down ITSELF, loudly, at a step boundary
+            print(f"[chaos] cycle {cycle}: injecting IO failure into "
+                  f"checkpoint write #{args.writer_fail}", flush=True)
+            env_v = dict(env)
+            env_v["UNICORE_TPU_CHAOS_WRITE_FAIL"] = str(args.writer_fail)
+            out = run_expect_write_failure(cmd, env_v)
+            report["kills"].append(
+                {"cycle": cycle, "writer_fail_at": args.writer_fail}
+            )
+        elif args.kill_in_write:
+            # land the signal inside the data->marker copy window of
+            # checkpoint_last's SECOND finalize (the first has no stale
+            # .sum yet, so only the second exercises the
+            # believable-data/stale-marker torn discrimination)
+            sentinel = os.path.join(workdir, f"write_window_{cycle}")
+            env_v = dict(env)
+            env_v["UNICORE_TPU_CHAOS_WRITE_HOLD"] = (
+                f"checkpoint_last:{sentinel}:6"
+            )
+            env_v["UNICORE_TPU_CHAOS_WRITE_HOLD_AT"] = "2"
+            print(f"[chaos] cycle {cycle}: "
+                  f"{'SIGTERM' if args.graceful else 'SIGKILL'} inside the "
+                  f"background write's data->marker window", flush=True)
+            out, _ = run_and_kill(
+                cmd, env_v, chaos_traj, graceful=args.graceful,
+                trigger=lambda: os.path.exists(sentinel),
+                desc="writer entered the data->marker hold window",
+            )
+            report["kills"].append({"cycle": cycle, "kill": "in-write"})
+        else:
+            # a corrupt leg tears the whole newest round, so at least TWO
+            # rounds must be on disk before the kill or there is nothing
+            # intact to fall back to
+            rounds_needed = 2 if args.corrupt != "none" else 1
+            lo = rounds_needed * args.save_interval_updates + 1
+            hi = max(lo + 1, args.max_update - 1)
+            kill_at = rng.randrange(lo, hi)
+            already = traj_lines(chaos_traj)
+            print(f"[chaos] cycle {cycle}: kill after {kill_at} new steps "
+                  f"({'SIGTERM' if args.graceful else 'SIGKILL'})",
+                  flush=True)
+            goal = already + kill_at
+            out, _ = run_and_kill(
+                cmd, env, chaos_traj, graceful=args.graceful,
+                trigger=lambda: traj_lines(chaos_traj) >= goal,
+                desc=f"{kill_at} new trajectory steps",
+            )
+            report["kills"].append({"cycle": cycle, "kill_at": kill_at})
         if args.graceful and "preemption" not in out:
             raise RuntimeError(
                 "graceful leg: no preemption notice in output:\n"
@@ -370,6 +455,17 @@ def main(argv=None):
             "corruption leg: resume did not report a torn-checkpoint "
             "fallback:\n" + out[-3000:]
         )
+    if args.kill_in_write and not args.graceful:
+        # the SIGKILL landed between checkpoint_last's data copy and its
+        # .sum copy: the data file is a COMPLETE pickle whose marker is
+        # the previous round's — restore must discriminate it as torn
+        # and fall back, never load the believable bytes unverified
+        if not report["fallback_used"]:
+            raise RuntimeError(
+                "kill-in-write leg: resume did not report the "
+                "torn-round fallback (the stale-marker checkpoint_last "
+                "was believed):\n" + out[-3000:]
+            )
 
     # -- verdict --------------------------------------------------------
     chaos_records = read_trajectory(chaos_traj)

@@ -29,6 +29,14 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   days later as a missing resume point.  Narrow handlers
   (``except FileNotFoundError:``) and handlers that log or re-raise
   are fine.
+- UL108 sync-in-step-loop: a blocking host sync — ``jax.device_get``,
+  ``.block_until_ready()``, or a synchronous checkpoint write
+  (``save_checkpoint``/``write_checkpoint``/``atomic_save``) — inside
+  a STEP LOOP (any ``for``/``while`` whose body calls
+  ``train_step``).  Each one stalls dispatch every iteration; the
+  async APIs exist precisely for these: the ``--stats-lag`` pipeline
+  defers the stats fetch, ``stage_batches`` double-buffers input, and
+  the background checkpoint writer streams saves off the step path.
 
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
@@ -94,6 +102,17 @@ _IO_METHOD_TAILS = {
 # like FileNotFoundError/ImportError are deliberate control flow)
 _BROAD_EXC_NAMES = {"Exception", "BaseException"}
 
+# UL108: a loop is a STEP LOOP iff its body dispatches train steps
+_STEP_LOOP_MARKERS = {"train_step"}
+# UL108: per-iteration host syncs (device_get also as a bare name from
+# ``from jax import device_get``); block_until_ready is matched as a
+# method tail like UL104 does
+_UL108_SYNC_TAILS = {"device_get", "block_until_ready"}
+# UL108: synchronous checkpoint writes — the background writer
+# (CheckpointManager --async-save / AsyncCheckpointWriter) exists so
+# the step path only ever pays the device->host capture
+_UL108_SAVE_TAILS = {"save_checkpoint", "write_checkpoint", "atomic_save"}
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -120,6 +139,7 @@ class _ModuleLint(ast.NodeVisitor):
         self.jax_aliases = {"jax"}
         self.jitted_names = set()
         self._with_seed_depth = 0
+        self._step_loop_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
 
@@ -465,6 +485,85 @@ class _ModuleLint(ast.NodeVisitor):
                 )
                 return
 
+    # -- UL108 ---------------------------------------------------------
+
+    def _loop_is_step_loop(self, loop):
+        """A for/while whose body calls ``train_step`` at this nesting
+        level.  Nested function defs are excluded (a closure defined in
+        a loop does not run per iteration) and so are NESTED loops: in
+        ``for epoch: (for batch: train_step(batch)); device_get(...)``
+        only the inner loop is the step loop — the epoch-level sync
+        runs once per epoch, which is exactly the sanctioned
+        fetch-at-real-boundaries pattern, not a per-step stall."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []) or [])
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.For, ast.AsyncFor,
+                                ast.While)):
+                continue
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain and chain.split(".")[-1] in _STEP_LOOP_MARKERS:
+                    return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _check_sync_in_step_loop(self, node):
+        if self._step_loop_depth == 0:
+            return
+        chain = _attr_chain(node.func)
+        if chain is None:
+            return
+        tail = chain.split(".")[-1]
+        if tail in _UL108_SYNC_TAILS:
+            self.emit(
+                "UL108", "sync-in-step-loop", "error", node,
+                f"'{chain}' inside the step loop — a per-iteration "
+                f"host sync that stalls dispatch; fetch stats through "
+                f"the lagged --stats-lag pipeline (flush_stats at real "
+                f"boundaries only) instead of blocking every step",
+            )
+        elif tail in _UL108_SAVE_TAILS:
+            self.emit(
+                "UL108", "sync-in-step-loop", "error", node,
+                f"synchronous checkpoint write '{chain}' inside the "
+                f"step loop — the step path should pay only the "
+                f"device->host capture; route saves through "
+                f"CheckpointManager's background writer (--async-save) "
+                f"so pickling+sha256+IO overlap the next steps",
+            )
+
+    def _visit_loop(self, node):
+        is_step = self._loop_is_step_loop(node)
+        if is_step:
+            self._step_loop_depth += 1
+        self.generic_visit(node)
+        if is_step:
+            self._step_loop_depth -= 1
+
+    def visit_For(self, node):
+        self._visit_loop(node)
+
+    def visit_While(self, node):
+        self._visit_loop(node)
+
+    def _visit_scope_reset(self, node):
+        # a function/lambda DEFINED inside a step loop does not run per
+        # iteration — its body is a fresh scope for UL108
+        saved, self._step_loop_depth = self._step_loop_depth, 0
+        self.generic_visit(node)
+        self._step_loop_depth = saved
+
+    def visit_FunctionDef(self, node):
+        self._visit_scope_reset(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_scope_reset(node)
+
+    def visit_Lambda(self, node):
+        self._visit_scope_reset(node)
+
     # -- UL107 ---------------------------------------------------------
 
     def _is_io_call(self, node):
@@ -555,6 +654,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._check_blocking(node)
         self._check_dropout_rate(node)
         self._check_where_nan(node)
+        self._check_sync_in_step_loop(node)
         self.generic_visit(node)
 
     def _visit_functions(self):

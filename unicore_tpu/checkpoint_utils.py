@@ -30,7 +30,6 @@ import re
 import shutil
 import time
 import traceback
-from multiprocessing.pool import ThreadPool
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +39,56 @@ class CheckpointIntegrityError(RuntimeError):
     ``.sum`` sidecar recorded at write time (or the file cannot be read
     at all after retries).  Restore paths catch this and fall back to
     the previous intact checkpoint."""
+
+
+# ----------------------------------------------------------------------
+# chaos hooks (tools/unicore_chaos.py): deterministic crash windows for
+# the background-write legs.  Both are inert without their env var and
+# trigger at most once per process, so a resumed run is unaffected.
+# ----------------------------------------------------------------------
+
+_CHAOS = {"writes": 0, "holds": 0, "held": False}
+
+
+def _chaos_take_write_fail():
+    """``UNICORE_TPU_CHAOS_WRITE_FAIL=K``: the K-th ``atomic_save`` of
+    this process fails (every retry) with an injected OSError — the
+    writer-IO-failure chaos leg, proving a failed background write
+    surfaces at the next step boundary instead of being swallowed."""
+    spec = os.environ.get("UNICORE_TPU_CHAOS_WRITE_FAIL")
+    if not spec:
+        return False
+    _CHAOS["writes"] += 1
+    return _CHAOS["writes"] == int(spec)
+
+
+def _chaos_finalize_hold(dst):
+    """``UNICORE_TPU_CHAOS_WRITE_HOLD=<substr>:<sentinel>:<secs>``: while
+    finalizing a destination whose path contains ``<substr>``, pause
+    BETWEEN the data copy and the ``.sum`` copy — the exact
+    kill-between-data-and-marker window — after touching ``<sentinel>``
+    so the harness knows the window is open and can SIGKILL/SIGTERM
+    into it.  Holds at the ``UNICORE_TPU_CHAOS_WRITE_HOLD_AT``-th
+    matching finalize (default 1; the harness uses 2 so a stale ``.sum``
+    from the previous round already sits at the destination), once per
+    process."""
+    spec = os.environ.get("UNICORE_TPU_CHAOS_WRITE_HOLD")
+    if not spec or _CHAOS["held"]:
+        return
+    substr, _, rest = spec.partition(":")
+    sentinel, _, secs = rest.rpartition(":")
+    if substr not in os.path.basename(dst):
+        return
+    _CHAOS["holds"] += 1
+    if _CHAOS["holds"] != int(
+            os.environ.get("UNICORE_TPU_CHAOS_WRITE_HOLD_AT", "1")):
+        return
+    _CHAOS["held"] = True
+    with open(sentinel, "w") as f:
+        f.write(dst)
+    logger.warning("CHAOS: holding %ss inside the data->marker copy "
+                   "window of %s", secs, dst)
+    time.sleep(float(secs))
 
 
 # ----------------------------------------------------------------------
@@ -85,8 +134,14 @@ def atomic_save(obj, filename, retries=3, backoff=0.5):
     the two leaves a data file whose sidecar mismatches (or is stale)
     and verified reads treat it as torn instead of silently loading a
     half-written state."""
+    inject_fail = _chaos_take_write_fail()
     for attempt in range(retries):
         try:
+            if inject_fail:
+                raise OSError(
+                    "chaos: injected checkpoint writer IO failure "
+                    "(UNICORE_TPU_CHAOS_WRITE_FAIL)"
+                )
             with open(filename + ".tmp", "wb") as f:
                 w = _HashingWriter(f)
                 pickle.dump(obj, w, protocol=4)
@@ -483,21 +538,48 @@ class BestTracker:
 
 
 class CheckpointManager:
-    """Owns checkpoint writing, retention, best tracking, and restore."""
+    """Owns checkpoint writing, retention, best tracking, and restore.
+
+    With ``--async-save`` (the default) the step path pays only the
+    device->host state capture; serialization, checksumming, final-dir
+    copies, and retention stream to disk on the bounded
+    :class:`~unicore_tpu.resilience.async_writer.AsyncCheckpointWriter`
+    while training dispatch continues.  A background write failure is
+    re-raised on the main thread at the next step boundary
+    (:meth:`poll`); ``--async-save off`` restores the fully synchronous
+    write (failures raise inline from :meth:`save`)."""
 
     def __init__(self, args, is_master):
         self.args = args
         self.is_master = is_master
         self.best = BestTracker(args.maximize_best_checkpoint_metric)
-        self._worker = None
+        self.async_save = str(getattr(args, "async_save", "on")) != "off"
+        self._writer = None
+        # step-path blocking attributable to saves (capture + submit
+        # backpressure + the whole write when sync): the
+        # checkpoint_save_stall_ms bench metric reads these deltas
+        self.stall_s = 0.0
+        self.saves = 0
         if is_master and not args.no_save:
             verify_checkpoint_directory(args.save_dir)
             verify_checkpoint_directory(args.tmp_save_dir)
-            # one background worker copies tmp-dir writes to the (possibly
-            # slow, shared) save dir and prunes — reference
-            # unicore_cli/train.py:60 + checkpoint_utils.py:22-75
-            self._worker = ThreadPool(processes=1)
+            if self.async_save:
+                self._writer = self._make_writer()
             self._sweep_stale_scratch()
+
+    def _make_writer(self):
+        from unicore_tpu.resilience import AsyncCheckpointWriter
+
+        return AsyncCheckpointWriter(
+            max_queue=int(getattr(self.args, "save_queue_size", 2) or 2)
+        )
+
+    @property
+    def writer(self):
+        """The background writer (None when sync or nothing to save) —
+        the trainer wires this into its watchdog context and rewind
+        interlock."""
+        return self._writer
 
     def _sweep_stale_scratch(self):
         """Clear torn scratch files a crash mid-``_finalize`` left in the
@@ -576,9 +658,11 @@ class CheckpointManager:
         Every process participates: the master writes the main file;
         every process holding sharded state (fsdp/tensor axes spanning
         processes) writes its ``.shard<p>`` sibling.  The device->host
-        fetch happens here synchronously (the arrays are donated to the
-        next step), but pickling + IO + copy + retention run on the
-        background worker — the step path never waits on the disk."""
+        capture happens here synchronously (the arrays are donated to
+        the next step); with async save on, pickling + IO + copy +
+        retention stream on the background writer and the step path
+        never waits on the disk — a failed background write surfaces at
+        the next boundary via :meth:`poll`, never silently."""
         improved = self.best.update(val_loss)
         if self.args.no_save or not do_save:
             return
@@ -614,36 +698,69 @@ class CheckpointManager:
             return  # pure DP non-master: nothing to persist
         scratch = os.path.join(self.args.tmp_save_dir, names[0])
         finals = [os.path.join(self.args.save_dir, n) for n in names]
+        import functools
+
         import jax
 
-        job = (state_dict, shard_entries, scratch, finals, end_of_epoch,
-               is_master, jax.process_index())
-        if self._worker is None:
-            # lazily provision a worker on shard-owning non-master hosts
-            verify_checkpoint_directory(self.args.save_dir)
-            verify_checkpoint_directory(self.args.tmp_save_dir)
-            self._worker = ThreadPool(processes=1)
-        self._worker.apply_async(self._write_and_finalize, job)
+        job = functools.partial(
+            self._write_and_finalize, state_dict, shard_entries, scratch,
+            finals, end_of_epoch, is_master, jax.process_index(),
+        )
+        if self.async_save:
+            if self._writer is None:
+                # lazily provision on shard-owning non-master hosts —
+                # and re-attach: the trainer wired ckpt.writer at
+                # startup, when it was still None here, so without this
+                # the rewind interlock and watchdog context would stay
+                # inert on exactly the hosts that write shards
+                verify_checkpoint_directory(self.args.save_dir)
+                verify_checkpoint_directory(self.args.tmp_save_dir)
+                self._writer = self._make_writer()
+                trainer.attach_checkpoint_writer(self._writer)
+            # the writer OWNS the host capture until its files land: the
+            # trainer's rewind ladder checks this before reinstalling
+            # (and then donating) state rebuilt from host buffers
+            self._writer.submit(
+                job, label=names[0], owned=(state_dict, shard_entries),
+            )
+            mode = "write is async"
+        else:
+            job()  # sync fallback: write failures raise RIGHT HERE
+            mode = "write was synchronous"
+        stall = time.perf_counter() - t0
+        self.stall_s += stall
+        self.saves += 1
         logger.info(
             "Saving checkpoint %s (epoch %d @ %d updates, score %s) "
-            "(state collection took %.1f seconds; write is async)",
-            scratch, epoch, updates, val_loss, time.perf_counter() - t0,
+            "(step path stalled %.2f seconds; %s)",
+            scratch, epoch, updates, val_loss, stall, mode,
         )
+
+    def poll(self):
+        """Surface a failed background write (CheckpointWriteError) on
+        the caller's thread; called by the train loop at every step
+        boundary.  No-op when sync or nothing failed."""
+        if self._writer is not None:
+            self._writer.poll()
+
+    def drain(self):
+        """Block until every submitted background save has landed, then
+        raise if any of them failed — the end-of-run / preemption gate
+        (a graceful exit-0 must prove its final checkpoint is on disk)."""
+        if self._writer is not None:
+            self._writer.drain()
+            self._writer.poll()
 
     def _write_and_finalize(self, state_dict, shard_entries, scratch,
                             finals, end_of_epoch, is_master, process_index):
-        """Worker-thread body: serialize, copy to final names, prune."""
-        try:
-            write_checkpoint(
-                state_dict, shard_entries, scratch, is_master, process_index,
-                shard_token=state_dict.get("shard_token"),
-            )
-        except Exception:
-            logger.error(
-                "checkpoint write to %s FAILED; skipping copy/retention for "
-                "this round", scratch, exc_info=True,
-            )
-            return
+        """Writer-thread body: serialize, copy to final names, prune.
+        Raises on write/copy failure — the async writer records it and
+        :meth:`poll` re-raises at the next step boundary (UL107: no
+        swallowed checkpoint IO)."""
+        write_checkpoint(
+            state_dict, shard_entries, scratch, is_master, process_index,
+            shard_token=state_dict.get("shard_token"),
+        )
         self._finalize(scratch, finals, end_of_epoch, is_master,
                        bool(shard_entries), process_index)
 
@@ -651,6 +768,7 @@ class CheckpointManager:
                   has_shards=False, process_index=0):
         """Copy the scratch write to its final names, then prune."""
         copied_any = False
+        failed = []
         pairs = []
         for dst in finals:
             if is_master:
@@ -667,14 +785,17 @@ class CheckpointManager:
                 # reads reject (stale/missing marker) instead of a
                 # silently-torn checkpoint
                 shutil.copyfile(src, dst)
+                _chaos_finalize_hold(dst)
                 shutil.copyfile(_sum_path(src), _sum_path(dst))
                 copied_any = True
                 logger.info("copied %s -> %s", src, dst)
-            except Exception:
-                logger.warning("checkpoint copy to %s failed; copy manually",
-                               dst)
+            except Exception as e:
+                logger.error("checkpoint copy to %s failed", dst,
+                             exc_info=True)
+                failed.append((dst, e))
         try:
-            if copied_any and self.args.tmp_save_dir != self.args.save_dir:
+            if (copied_any and not failed
+                    and self.args.tmp_save_dir != self.args.save_dir):
                 for p in (scratch, shard_file(scratch, process_index)):
                     for q in (p, _sum_path(p)):
                         if os.path.lexists(q):
@@ -683,12 +804,23 @@ class CheckpointManager:
                 _prune(self.args, end_of_epoch)
         except Exception:
             logger.warning("checkpoint retention pass failed", exc_info=True)
+        if failed:
+            from unicore_tpu.resilience import CheckpointWriteError
+
+            raise CheckpointWriteError(
+                "checkpoint finalize failed for "
+                + ", ".join(dst for dst, _ in failed)
+                + f": {failed[0][1]} (scratch kept at {scratch})"
+            ) from failed[0][1]
 
     def close(self):
-        if self._worker is not None:
-            self._worker.close()
-            self._worker.join()
-            self._worker = None
+        """Drain the background writer (every queued save lands before
+        the process exits); failures are logged by the writer and left
+        for :meth:`drain`/:meth:`poll` callers — close() itself must be
+        safe inside ``finally`` blocks."""
+        if self._writer is not None:
+            self._writer.close(drain=True)
+            self._writer = None
 
     # -- restore -------------------------------------------------------
 

@@ -17,6 +17,10 @@ Four cooperating pieces (docs/fault_tolerance.md):
 - :mod:`trajectory` — the per-update JSONL loss-trajectory writer the
   chaos harness (``tools/unicore_chaos.py``) compares bit-exactly
   against an uninterrupted oracle run.
+- :mod:`async_writer` — the background checkpoint writer: pickling +
+  sha256 + final-dir copies stream to disk off the step path, with a
+  bounded queue, drain-on-shutdown, and failures re-raised at the next
+  step boundary (never swallowed).
 
 Checkpoint INTEGRITY (per-file checksums, verified reads with
 retry/backoff, fallback to the previous intact checkpoint) lives in
@@ -29,6 +33,10 @@ from .anomaly import (  # noqa: F401
     EscalationPolicy,
     guard_init,
     guard_update,
+)
+from .async_writer import (  # noqa: F401
+    AsyncCheckpointWriter,
+    CheckpointWriteError,
 )
 from .preemption import GracefulShutdown  # noqa: F401
 from .snapshot import SnapshotRing, snapshot_state, restore_state  # noqa: F401

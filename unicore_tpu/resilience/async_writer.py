@@ -1,0 +1,258 @@
+"""Background checkpoint writer: saves stream to disk off the step path.
+
+The synchronous part of a save is only the device->host capture (cheap:
+per-device shard copies, the same no-global-assembly discipline the
+:class:`~unicore_tpu.resilience.snapshot.SnapshotRing` uses).  Pickling,
+sha256 hashing, the final-dir copies, and retention all run here, on ONE
+daemon worker thread, while training dispatch continues — the
+step-boundary overlap of PAPERS.md "Exploring the limits of Concurrency
+in ML Training on Google TPUs" (arxiv 2011.03641).
+
+Moving IO off the step path multiplies the crash windows the integrity
+layer (checkpoint_utils) closed, so this class is built around four
+hard rules rather than raw throughput:
+
+1. **No swallowed IO.**  A failed background write is recorded and
+   RE-RAISED on the main thread at the next step boundary
+   (:meth:`poll`) as :class:`CheckpointWriteError` — the run must never
+   believe a save landed that never hit the disk.  (The write itself
+   keeps ``atomic_save``'s marker-last ordering, so a SIGKILL mid-write
+   leaves a sweepable/torn round, never a believable-but-rotted one.)
+2. **Bounded queue.**  ``submit`` BLOCKS once ``max_queue`` saves are
+   in flight (the wait is counted, surfacing in
+   ``checkpoint_save_stall_ms``): if the disk cannot keep up with the
+   save interval, the step path feels backpressure instead of host
+   memory filling with queued state copies.
+3. **Drain on shutdown.**  :meth:`drain` blocks until every submitted
+   job has landed (FIFO), so the preemption path can guarantee its
+   final checkpoint is on disk before ``exit(0)``, and failures found
+   while draining still raise.
+4. **Capture ownership.**  Each job owns its host capture until its
+   files land (:meth:`owns`/:meth:`wait_released`).  The anomaly-guard
+   rewind ladder must not reinstall — and then DONATE to the next step
+   — buffers the writer is still hashing: on backends where
+   ``device_put`` may alias host memory, that would rot the bytes
+   mid-pickle into a checkpoint that passes its own checksum.  The
+   trainer's rewind therefore waits for release first.
+"""
+
+import collections
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed after retries.  Raised on the
+    MAIN thread at the next step boundary (or while draining), so the
+    failure is attributable and the supervisor restarts from the last
+    checkpoint that actually landed."""
+
+
+class _Job:
+    __slots__ = ("label", "fn", "owned", "done")
+
+    def __init__(self, label, fn, owned):
+        self.label = label
+        self.fn = fn
+        self.owned = owned
+        self.done = threading.Event()
+
+
+class AsyncCheckpointWriter:
+    """One background thread draining a bounded FIFO of save jobs."""
+
+    def __init__(self, max_queue=2):
+        self.max_queue = max(1, int(max_queue))
+        self._jobs = collections.deque()
+        self._lock = threading.Lock()
+        self._slot_free = threading.Condition(self._lock)
+        self._job_ready = threading.Condition(self._lock)
+        self._failures = []
+        self._owned_ids = {}
+        self._active = None
+        self._active_since = None
+        self._closed = False
+        self._thread = None
+        self.stats = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "backpressure_waits": 0, "backpressure_wait_s": 0.0,
+        }
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, fn, *, label="checkpoint", owned=()):
+        """Queue ``fn`` (no-arg callable doing the write).  Blocks while
+        ``max_queue`` jobs are already pending/active — the bounded-queue
+        backpressure rule — and returns the wait spent doing so.
+
+        ``owned``: host-capture objects this job serializes from; they
+        stay registered (:meth:`owns`) until the job finishes."""
+        job = _Job(label, fn, tuple(owned))
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            waited = False
+            while self._pending_locked() >= self.max_queue:
+                waited = True
+                self._slot_free.wait(timeout=1.0)
+                if self._closed:
+                    raise RuntimeError("AsyncCheckpointWriter is closed")
+            for obj in job.owned:
+                self._owned_ids[id(obj)] = (
+                    self._owned_ids.get(id(obj), (0, None))[0] + 1, obj
+                )
+            self._jobs.append(job)
+            self.stats["submitted"] += 1
+            if waited:
+                wait_s = time.perf_counter() - t0
+                self.stats["backpressure_waits"] += 1
+                self.stats["backpressure_wait_s"] += wait_s
+                logger.warning(
+                    "checkpoint writer backpressure: waited %.2fs for a "
+                    "queue slot (disk slower than the save interval?)",
+                    wait_s,
+                )
+            self._job_ready.notify()
+        self._ensure_thread()
+        return time.perf_counter() - t0
+
+    def _pending_locked(self):
+        return len(self._jobs) + (1 if self._active is not None else 0)
+
+    # -- worker --------------------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._work, name="unicore-ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _work(self):
+        while True:
+            with self._lock:
+                while not self._jobs:
+                    if self._closed:
+                        return
+                    self._job_ready.wait(timeout=1.0)
+                job = self._jobs.popleft()
+                self._active = job
+                self._active_since = time.monotonic()
+            try:
+                job.fn()
+                with self._lock:
+                    self.stats["completed"] += 1
+            except BaseException as e:  # surfaced via poll(), never lost
+                logger.error(
+                    "background checkpoint write %r FAILED: %s",
+                    job.label, e, exc_info=True,
+                )
+                with self._lock:
+                    self.stats["failed"] += 1
+                    self._failures.append((job.label, e))
+            finally:
+                with self._lock:
+                    self._active = None
+                    self._active_since = None
+                    for obj in job.owned:
+                        count, ref = self._owned_ids.get(id(obj), (0, None))
+                        if count <= 1:
+                            self._owned_ids.pop(id(obj), None)
+                        else:
+                            self._owned_ids[id(obj)] = (count - 1, ref)
+                    self._slot_free.notify_all()
+                job.done.set()
+
+    # -- main-thread surface -------------------------------------------
+
+    def poll(self):
+        """Raise the oldest un-surfaced background failure (if any).
+
+        Called at every step boundary: a write that failed mid-overlap
+        surfaces HERE, on the main thread, at the first boundary after
+        it — the no-swallowed-IO rule.  Remaining failures surface on
+        subsequent polls."""
+        with self._lock:
+            if not self._failures:
+                return
+            label, err = self._failures.pop(0)
+        raise CheckpointWriteError(
+            f"background checkpoint write {label!r} failed: {err}"
+        ) from err
+
+    def in_flight(self):
+        with self._lock:
+            return self._pending_locked()
+
+    def owns(self, obj):
+        """Is ``obj`` a capture some queued/active job still reads?"""
+        with self._lock:
+            return id(obj) in self._owned_ids
+
+    def wait_released(self, obj, timeout=None):
+        """Block until no job owns ``obj``; returns the wait in seconds
+        (the rewind ladder calls this before reinstalling a snapshot)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.perf_counter()
+        while self.owns(obj):
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    "checkpoint writer did not release the capture within "
+                    f"{timeout}s"
+                )
+            time.sleep(0.01)
+        return time.perf_counter() - t0
+
+    def drain(self, timeout=None):
+        """Block until every submitted job has finished (FIFO order).
+        Does NOT raise on recorded failures — call :meth:`poll` after if
+        the caller must know (close(raise_on_failure=True) does)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                job = self._active or (self._jobs[0] if self._jobs else None)
+            if job is None:
+                return True
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+            job.done.wait(timeout=remaining)
+
+    def status(self):
+        """One-line writer state for watchdog dumps: lets a timeout
+        report distinguish a slow background writer (harmless to the
+        device) from a hung device step."""
+        with self._lock:
+            if self._active is not None:
+                busy = time.monotonic() - (self._active_since or 0)
+                return (
+                    f"background checkpoint writer: WRITING "
+                    f"{self._active.label!r} for {busy:.1f}s "
+                    f"({len(self._jobs)} queued) — a slow writer does not "
+                    f"block device dispatch; this timeout is about the "
+                    f"device step itself"
+                )
+            queued = len(self._jobs)
+        if queued:
+            return f"background checkpoint writer: {queued} job(s) queued"
+        return "background checkpoint writer: idle"
+
+    def close(self, drain=True, raise_on_failure=False):
+        """Stop the worker; with ``drain`` (default) every queued save
+        lands first — the preemption exit-0 guarantee."""
+        if drain:
+            self.drain()
+        with self._lock:
+            self._closed = True
+            self._job_ready.notify_all()
+            self._slot_free.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if raise_on_failure:
+            self.poll()

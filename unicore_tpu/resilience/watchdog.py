@@ -39,11 +39,19 @@ def _default_timeout_action(phase, timeout):
 
 
 class StepWatchdog:
-    """``with watchdog.armed("train_step/dispatch"): <blocking call>``."""
+    """``with watchdog.armed("train_step/dispatch"): <blocking call>``.
 
-    def __init__(self, timeout, on_timeout=None):
+    ``context``: optional no-arg callable whose string is logged when
+    the watchdog fires — the trainer wires the checkpoint writer's
+    :meth:`~unicore_tpu.resilience.async_writer.AsyncCheckpointWriter.status`
+    here so a timeout dump distinguishes a slow background writer
+    (which never blocks device dispatch) from a genuinely hung device
+    step before the process exits 87."""
+
+    def __init__(self, timeout, on_timeout=None, context=None):
         self.timeout = float(timeout)
         self.on_timeout = on_timeout or _default_timeout_action
+        self.context = context
         self.fired = False
         self._phase = None
         self._deadline = None
@@ -101,6 +109,11 @@ class StepWatchdog:
             if deadline is not None and time.monotonic() > deadline:
                 self.fired = True
                 self._disarm()
+                if self.context is not None:
+                    try:
+                        logger.error("watchdog context: %s", self.context())
+                    except Exception:  # unicore-lint: disable=all -- context is best-effort diagnostics
+                        pass
                 self.on_timeout(phase, self.timeout)
                 continue
             if deadline is None:

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from unicore_tpu.logging import metrics
 
 from .attention import PagedMeta
-from .kv_pool import PagedKVPool
+from .kv_pool import PagedKVPool, PoolExhausted
 from .sampling import sample_tokens, step_keys
 from .scheduler import Scheduler
 
@@ -91,6 +91,7 @@ class ServeEngine:
             "prefills": 0, "decode_steps": 0, "decode_tokens": 0,
             "generated_tokens": 0, "peak_pool_occupancy": 0.0,
             "decode_time_s": 0.0, "wall_time_s": 0.0,
+            "pool_exhausted_recoveries": 0,
         }
 
     # -- pool buffers --------------------------------------------------
@@ -429,19 +430,38 @@ class ServeEngine:
     def _run_to_completion(self, sched):
         stalled = 0
         while sched.has_work():
-            # admit() hands back fresh AND resumed sequences — a resumed
-            # one re-prefills prompt+generated, recreating exactly the
-            # KV state its eviction dropped
-            admitted = sched.admit(bucket=self.bucket_fn)
-            for seq in admitted:
-                self._prefill(seq)
-            sched.chaos_preempt()
-            did_decode = False
-            if sched.running:
-                todo = sched.prepare_decode()
-                if todo:
-                    self._decode(todo)
-                    did_decode = True
+            try:
+                # admit() hands back fresh AND resumed sequences — a
+                # resumed one re-prefills prompt+generated, recreating
+                # exactly the KV state its eviction dropped
+                admitted = sched.admit(bucket=self.bucket_fn)
+                for seq in admitted:
+                    self._prefill(seq)
+                sched.chaos_preempt()
+                did_decode = False
+                if sched.running:
+                    todo = sched.prepare_decode()
+                    if todo:
+                        self._decode(todo)
+                        did_decode = True
+            except PoolExhausted:
+                # a pathological admission race got past the
+                # can_alloc/extend guards (e.g. page accounting the
+                # scheduler didn't see move).  This is recoverable,
+                # not fatal: preempt the scheduler's LIFO victim — the
+                # same requeue-front path organic exhaustion takes, so
+                # nothing is lost and its re-prefill recreates the
+                # dropped KV — and retry the step on the freed pages.
+                if not sched.running:
+                    raise  # nothing to evict: the pool is truly too small
+                sched.preempt(sched._pick_victim())
+                self.stats["pool_exhausted_recoveries"] += 1
+                metrics.log_scalar(
+                    "serve/pool_exhausted_recoveries",
+                    self.stats["pool_exhausted_recoveries"],
+                )
+                stalled = 0  # freed pages guarantee the retry progresses
+                continue
             self.stats["peak_pool_occupancy"] = max(
                 self.stats["peak_pool_occupancy"], self.pool.occupancy()
             )

@@ -134,8 +134,23 @@ class Scheduler:
                 break
             if not self.pool.can_alloc(len(seq.prefix())):
                 break
+            # alloc BEFORE popping: if the pool raises anyway (an
+            # admission race the can_alloc check missed), the sequence
+            # is still at waiting[0] — nothing is lost from either
+            # queue.  With earlier admissions this call, swallow the
+            # raise and return the partial batch (the caller must
+            # prefill those; an escaping exception would strand them in
+            # `running` with allocated-but-never-written KV pages).
+            # Only an EMPTY admission re-raises, for the engine's
+            # preempt-a-victim-and-retry recovery — so a PoolExhausted
+            # escaping admit() guarantees no half-admitted state.
+            try:
+                self.pool.alloc(seq.sid, len(seq.prefix()))
+            except PoolExhausted:
+                if admitted:
+                    break
+                raise
             self.waiting.popleft()
-            self.pool.alloc(seq.sid, len(seq.prefix()))
             self.running.append(seq)
             admitted.append(seq)
             budget -= cost

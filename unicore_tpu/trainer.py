@@ -94,6 +94,24 @@ def _map_host_arrays(fn, tree):
     )
 
 
+class StagedBatch:
+    """A micro-batch group already stacked and device-put.
+
+    The train loop stages the NEXT group right after dispatching the
+    current step, so the host-side stacking and the host->device
+    transfer overlap device compute (input double-buffering); the next
+    ``train_step`` call then goes straight to dispatch.
+    ``first_sample`` keeps the raw first micro-batch for state init and
+    the NanDetector re-run."""
+
+    __slots__ = ("batches", "weights_np", "first_sample")
+
+    def __init__(self, batches, weights_np, first_sample):
+        self.batches = batches
+        self.weights_np = weights_np
+        self.first_sample = first_sample
+
+
 def _looks_like_oom(e):
     """Allocator failures surface as XlaRuntimeError RESOURCE_EXHAUSTED."""
     text = f"{type(e).__name__}: {e}"
@@ -251,6 +269,17 @@ class Trainer:
         self._pending_stats: List[Any] = []
         self._dispatch_count: Optional[int] = None
         self._valid_batch_idx = 0
+        # step-boundary host-time accounting (bench step_boundary_host_ms):
+        # wall time from one compiled call's return to the next one's
+        # invocation = every host-side thing between dispatches (stats
+        # bookkeeping, staging, boundary checks, save capture)
+        self.host_timers = {"step_boundary_host_s": 0.0,
+                            "step_boundaries": 0}
+        self._boundary_started = None
+        # background checkpoint writer (attached by the CLI from the
+        # CheckpointManager): consulted by the rewind interlock and the
+        # watchdog's timeout context
+        self._ckpt_writer = None
 
         self._logging_proto_cached = None
         self._start_time = time.time()
@@ -879,9 +908,27 @@ class Trainer:
     # host-side step wrappers
     # ------------------------------------------------------------------
 
+    def stage_batches(self, samples: List[Dict[str, Any]]):
+        """Stack ``samples`` and move them to device NOW, returning a
+        :class:`StagedBatch` a later :meth:`train_step` consumes.
+
+        The train loop calls this for group N+1 right after dispatching
+        step N: the device is still executing, so the numpy stacking and
+        the host->device transfer ride for free (input
+        double-buffering).  Position-exactness note for the chaos
+        contract: callers must only stage a group they will dispatch
+        before the next checkpoint boundary — the data iterator's cursor
+        advances at the pull."""
+        if isinstance(samples, StagedBatch):
+            return samples
+        batches, weights_np = self._stack_microbatches(samples)
+        return StagedBatch(batches, weights_np, samples[0])
+
     @metrics.aggregate("train")
-    def train_step(self, samples: List[Dict[str, Any]]):
-        """One update: grad accumulation over ``samples`` micro-batches.
+    def train_step(self, samples):
+        """One update: grad accumulation over ``samples`` micro-batches
+        (a list of raw micro-batches, or a :class:`StagedBatch` from
+        :meth:`stage_batches`).
 
         With ``stats_lag > 0`` the returned logging outputs are those of
         the step dispatched ``stats_lag`` calls ago (None while the
@@ -889,10 +936,11 @@ class Trainer:
         checks, checkpoint, validation) call :meth:`flush_stats` first.
         """
         self._set_seed_noop()
+        staged = self.stage_batches(samples)
         if self.state is None:
-            self.init_state(samples[0])
+            self.init_state(staged.first_sample)
 
-        batches, weights_np = self._stack_microbatches(samples)
+        batches, weights_np = staged.batches, staged.weights_np
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
             self._compiled_train_step = None
@@ -925,6 +973,11 @@ class Trainer:
             1.0 if (self._chaos_inject is not None
                     and dispatch_idx == self._chaos_inject[1]) else 0.0
         )
+        if self._boundary_started is not None:
+            self.host_timers["step_boundary_host_s"] += (
+                time.perf_counter() - self._boundary_started
+            )
+            self.host_timers["step_boundaries"] += 1
         try:
             with jax.profiler.TraceAnnotation("train_step/dispatch"):
                 self.state, stats = self._dispatch_train_step(
@@ -939,6 +992,9 @@ class Trainer:
             if _looks_like_oom(e):
                 logger.error(self._oom_guidance())
             raise
+        # the compiled call returned (dispatch is async on TPU): host
+        # time from here to the next compiled call is step-boundary work
+        self._boundary_started = time.perf_counter()
 
         mem_every = int(getattr(self.args, "log_memory", 0) or 0)
         if mem_every > 0 and self._dispatch_count % mem_every == 0:
@@ -950,7 +1006,7 @@ class Trainer:
                 )
 
         self._pending_stats.append(
-            (stats, weights_np, samples[0], dispatch_idx)
+            (stats, weights_np, staged.first_sample, dispatch_idx)
         )
         out = None
         while len(self._pending_stats) > self.stats_lag:
@@ -1201,6 +1257,15 @@ class Trainer:
     # resilience: trajectory, snapshot ring, rewind
     # ------------------------------------------------------------------
 
+    def attach_checkpoint_writer(self, writer):
+        """Wire the CheckpointManager's background writer in: the
+        watchdog's timeout dump then names the writer's state (a slow
+        background write must not read as a hung device step), and the
+        rewind ladder serializes against in-flight saves."""
+        self._ckpt_writer = writer
+        if writer is not None:
+            self._watchdog.context = writer.status
+
     def _record_trajectory(self, stats, dispatch_idx, action):
         if self._trajectory is None:
             return
@@ -1253,6 +1318,24 @@ class Trainer:
                 "frequency or --anomaly-abort-after)"
             )
         snap_updates, _snap_dispatch, snap = entry
+        writer = self._ckpt_writer
+        if writer is not None and (writer.owns(snap) or writer.in_flight()):
+            # the rewind must NOT reinstall (and then donate to the next
+            # step) host state while the background writer is still
+            # hashing a capture from the same timeline: on backends
+            # where device_put can alias host memory, donation would rot
+            # the bytes mid-pickle into a checkpoint that passes its own
+            # checksum.  Waiting also keeps the landed-checkpoint set
+            # ordered with the rewind — no save finalizes "during" it.
+            t0 = time.perf_counter()
+            writer.drain()
+            waited = time.perf_counter() - t0
+            metrics.log_scalar("anomaly_rewind_writer_wait_s", waited,
+                               priority=640, round=2, weight=0)
+            logger.warning(
+                "anomaly guard: rewind waited %.2fs for the background "
+                "checkpoint writer to release its in-flight save", waited,
+            )
         from unicore_tpu.resilience import restore_state
 
         live_guard = jax.device_get(self.state["guard"])

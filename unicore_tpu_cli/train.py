@@ -160,10 +160,22 @@ class TrainLoop:
         self.trainer.begin_epoch(epoch_itr.epoch)
         valid_losses, stop = [None], False
         num_updates = self.trainer.get_num_updates()
+        # a resumed run can ALREADY sit at a stop limit — e.g. the
+        # previous process was signalled while its FINAL save streamed
+        # on the background writer, so its checkpoint carries
+        # max-update state.  The in-loop check runs only AFTER a
+        # dispatch; without this pre-check such a resume trains one
+        # update past the limit (caught by the chaos harness's
+        # kill-during-background-write legs: 11 updates vs the
+        # oracle's --max-update 10)
+        if self._hit_hard_limits():
+            return valid_losses, True
         logger.info("Start iterating over samples")
-        for samples in _annotate_iter(progress, "train/data-wait"):
+        stream = _annotate_iter(progress, "train/data-wait")
+        staged = self._next_staged(stream)
+        while staged is not None:
             with metrics.aggregate("train_inner"):
-                log_output = self.trainer.train_step(samples)
+                log_output = self.trainer.train_step(staged)
 
             if log_output is not None:
                 num_updates = self.trainer.get_num_updates()
@@ -179,6 +191,12 @@ class TrainLoop:
             )
             if stop:
                 break
+            # input double-buffering: pull + stack + device-put group N+1
+            # while the device still executes step N.  Deliberately AFTER
+            # the boundary above, so a preemption checkpoint's iterator
+            # position never counts a group that was staged but not
+            # dispatched (the chaos harness's bit-exact resume contract).
+            staged = self._next_staged(stream)
 
         logger.info("end of epoch %d (average epoch stats below)",
                     epoch_itr.epoch)
@@ -189,8 +207,22 @@ class TrainLoop:
         metrics.reset_meters("train")
         return valid_losses, stop
 
+    def _next_staged(self, stream):
+        """Pull the next micro-batch group and stage it onto the device
+        (overlaps the currently-executing step); None at epoch end."""
+        samples = next(stream, None)
+        if samples is None:
+            return None
+        with jax.profiler.TraceAnnotation("train/stage"):
+            return self.trainer.stage_batches(samples)
+
     def validate_and_save(self, epoch_itr, end_of_epoch):
         args = self.args
+        # a background checkpoint write that failed since the last
+        # boundary surfaces HERE, on the main thread, before anything
+        # else this round — the run must never keep training on the
+        # belief that a save landed when it did not
+        self.ckpt.poll()
         # preemption (SIGTERM/SIGINT): flush the lagged pipeline so the
         # checkpoint carries exact counts, write it, and stop — the save
         # rides the normal do_save=stop path below; validation is skipped
@@ -372,6 +404,10 @@ def main(args) -> None:
     is_master = getattr(args, "distributed_rank", 0) == 0
     ckpt = CheckpointManager(args, is_master)
     extra_state, epoch_itr = ckpt.restore(trainer, disable_iterator_cache=False)
+    # the watchdog's timeout dump names the writer state (slow background
+    # write != hung device step) and the rewind ladder serializes against
+    # in-flight background saves
+    trainer.attach_checkpoint_writer(ckpt.writer)
 
     shutdown = None
     if not getattr(args, "no_graceful_shutdown", False):
@@ -384,6 +420,12 @@ def main(args) -> None:
     loop = TrainLoop(args, trainer, task, ckpt, shutdown=shutdown)
     try:
         loop.run(epoch_itr)
+        # the exit-0 gate: every in-flight background save must LAND
+        # before the run may report success — and a failed one raises
+        # here (non-zero exit) instead of vanishing with the process.
+        # A preemption exit passes through this same gate, so a
+        # graceful SIGTERM's final checkpoint is provably on disk.
+        ckpt.drain()
     finally:
         # order matters: the checkpoint worker drains BEFORE the process
         # exits (a preemption save must land on disk), then the trainer
