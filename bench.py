@@ -543,7 +543,7 @@ def _microbench(out):
     # realistic small-LM shape.  One engine instance is reused so the
     # jitted prefill/decode executables compile once (warmup request)
     # and the measured numbers are steady-state, like production serving.
-    def _serve_engine():
+    def _serve_engine(**engine_kw):
         from examples.lm.model import TransformerLMModel
         from unicore_tpu.serve.engine import ServeEngine
 
@@ -560,6 +560,7 @@ def _microbench(out):
         )["params"]
         return model, ServeEngine(
             model, params, num_pages=40, page_size=64, max_batch=8,
+            **engine_kw,
         )
 
     def _serve_micros():
@@ -596,6 +597,69 @@ def _microbench(out):
         return round(d_tok / d_t, 1)
 
     _micro_guard(out, "serve_decode_tokens_per_sec", _serve_micros)
+
+    # serve robustness (ISSUE 7): overload + drain behavior at the same
+    # serve shape.  A seeded 2x-capacity flood against a bounded waiting
+    # queue yields the shed rate (deterministic: same seed, same sheds)
+    # and the decode p99 under pressure (steady-state window — warmup
+    # compiles are excluded by snapshotting the latency ring first);
+    # then a SIGTERM-equivalent drain on a WARM engine measures
+    # request-drain-to-idle latency (in-flight work runs its tail out,
+    # nothing re-admits).
+    def _serve_robustness():
+        import threading
+
+        from unicore_tpu.resilience.preemption import GracefulShutdown
+        from unicore_tpu.serve.scheduler import Request
+
+        srng = np.random.RandomState(1)
+
+        def reqs(n, prompt_len, max_new):
+            return [Request(
+                prompt=srng.randint(1, 4096, size=(prompt_len,)).tolist(),
+                max_new_tokens=max_new, seed=i, request_id=f"b{i}",
+            ) for i in range(n)]
+
+        max_waiting = 8
+        model, engine = _serve_engine(max_waiting=max_waiting)
+        capacity = engine.max_batch + max_waiting
+        engine.generate(reqs(2, 128, 2))  # warmup: compile + pool touch
+        n0 = len(engine.decode_ms)
+        flood = reqs(2 * capacity, 128, 32)
+        results = engine.generate(flood)
+        shed = sum(1 for r in results if r.finish_reason == "shed")
+        window = list(engine.decode_ms)[n0:]
+        out["serve_decode_p99_ms"] = round(
+            float(np.percentile(window, 99)), 2)
+        out["serve_flood_requests"] = len(flood)
+
+        # drain: warm second engine, request drain mid-stream, time to
+        # fully idle (the generate() thread returning with every
+        # request terminal and the pool clean)
+        sd = GracefulShutdown()  # not installed: programmatic trigger
+        model2, engine2 = _serve_engine(shutdown=sd)
+        del model2
+        engine2.generate(reqs(2, 128, 2))  # warm compiles
+        done = {}
+
+        def run():
+            done["results"] = engine2.generate(reqs(8, 128, 64))
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.time() + 120
+        while engine2.stats["decode_steps"] < 8 and time.time() < deadline:
+            time.sleep(0.001)
+        t0 = time.perf_counter()
+        sd.request()
+        t.join(timeout=120)
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        assert not t.is_alive() and engine2.pool.is_idle(), (
+            "drain did not reach idle")
+        out["serve_drain_ms"] = round(drain_ms, 2)
+        return round(shed / len(flood), 4)
+
+    _micro_guard(out, "serve_shed_rate", _serve_robustness)
 
     # step-boundary overlap (ISSUE 6): host time BETWEEN compiled
     # dispatches (stats bookkeeping, staging, boundary checks) and the

@@ -726,6 +726,82 @@ def test_sync_in_step_loop_inline_suppression_and_closure(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL109 unbounded-queue-growth
+# ---------------------------------------------------------------------
+
+def test_unbounded_queue_growth_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "server.py", """
+        def serve_forever(sched, source, backlog):
+            while True:
+                req = source.get()
+                sched.waiting.append(req)        # no bound, no shed
+                backlog.insert(0, req)           # second offender
+                sched.admit()
+        def drive(sched, reqs):
+            for r in reqs:
+                retry_queue.appendleft(r)        # third offender
+                sched.prepare_decode()
+        def poll_then_drain(sched, source, k):
+            # the scheduling marker lives in a NESTED loop: the outer
+            # while still grows the queue once per serve cycle, so it
+            # must classify as the serve loop (regression: the UL108
+            # nested-loop exclusion must not apply here)
+            while True:
+                queue.append(source.get())       # fourth offender
+                for _ in range(k):
+                    sched.admit()
+    """)
+    assert sum(1 for f in found if f.rule == "UL109") == 4
+
+
+def test_unbounded_queue_growth_silent_on_bounded_and_shed(tmp_path):
+    found = _lint_snippet(tmp_path, "server.py", """
+        def bounded(sched, source, max_waiting):
+            while True:
+                req = source.get()
+                # bound check on the same collection sanctions growth
+                if len(sched.waiting) < max_waiting:
+                    sched.waiting.append(req)
+                sched.admit()
+        def drains(sched, source):
+            while True:
+                sched.waiting.append(source.get())
+                sched.waiting.popleft()          # drain path
+                sched.admit()
+        def sheds(sched, source):
+            while True:
+                req = source.get()
+                sched.waiting.append(req)
+                shed_overflow(sched)             # a shed path in sight
+                sched.admit()
+        def not_a_serve_loop(out, items):
+            for x in items:                      # no scheduling markers
+                out.append(x)
+        def closure_in_loop(sched, reqs):
+            hooks = []
+            while True:
+                sched.admit()
+                if len(hooks) > 4:
+                    break
+                def late(q, r):
+                    q.append(r)                  # closure: fresh scope
+                hooks.append(late)
+    """)
+    assert "UL109" not in rules_of(found)
+
+
+def test_unbounded_queue_growth_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "server.py", """
+        def serve_forever(sched, source):
+            while True:
+                req = source.get()
+                sched.waiting.append(req)  # unicore-lint: disable=UL109
+                sched.admit()
+    """)
+    assert "UL109" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # Pass 3: HLO parsing primitives (pure text, no compile)
 # ---------------------------------------------------------------------
 

@@ -393,6 +393,380 @@ def test_engine_capacity_finish(lm):
     assert res.tokens == want
 
 
+# -- robustness: deadlines, shedding, starvation, quarantine, drain --------
+# (ISSUE 7; docs/serving.md#robustness)
+
+
+class _Clock:
+    """Manual host clock for exact deadline/drain timing in tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tick_per_decode(engine, clock, dt=10.0, hook=None):
+    """Advance the fake clock after every decode step (as if each step
+    took ``dt`` seconds); ``hook(step_count)`` runs after the tick."""
+    orig = engine._decode
+
+    def ticking(seqs):
+        orig(seqs)
+        clock.t += dt
+        if hook is not None:
+            hook(engine.stats["decode_steps"])
+
+    engine._decode = ticking
+
+
+def test_deadline_expiry_mid_decode_frees_pages(lm):
+    """A running request whose TTL blows mid-stream finishes 'expired'
+    at the next decode boundary, its pages free immediately, and the
+    tokens it DID emit match the solo oracle prefix; requests without a
+    deadline are untouched."""
+    model, params = lm
+    clock = _Clock()
+    engine = ServeEngine(model, params, num_pages=16, page_size=4,
+                         max_batch=4, clock=clock)
+    _tick_per_decode(engine, clock, dt=10.0)  # 10 "seconds" per step
+    reqs = [
+        Request(prompt=[3, 7, 2], max_new_tokens=12, request_id="dies",
+                deadline_ms=25_000.0),
+        Request(prompt=[11, 4, 9], max_new_tokens=12,
+                request_id="lives"),
+    ]
+    by = {r.request_id: r for r in engine.generate(reqs)}
+    assert by["dies"].finish_reason == "expired"
+    assert 0 < len(by["dies"].tokens) < 12
+    want = solo_greedy(model, params, [3, 7, 2], 12)
+    assert by["dies"].tokens == want[: len(by["dies"].tokens)]
+    assert by["lives"].finish_reason == "length"
+    assert by["lives"].tokens == solo_greedy(model, params, [11, 4, 9],
+                                             12)
+    assert engine.stats["expired"] == 1
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_deadline_expiry_in_waiting_queue(lm):
+    """A request that never leaves the waiting queue before its TTL
+    expires at the ADMISSION boundary: zero tokens, no TTFT, no pages
+    ever held."""
+    model, params = lm
+    clock = _Clock()
+    engine = ServeEngine(model, params, num_pages=16, page_size=4,
+                         max_batch=1, clock=clock)
+    _tick_per_decode(engine, clock, dt=10.0)
+    reqs = [
+        Request(prompt=[3, 7, 2], max_new_tokens=6, request_id="runs"),
+        Request(prompt=[5, 9], max_new_tokens=4, request_id="starves",
+                deadline_ms=15_000.0),
+    ]
+    by = {r.request_id: r for r in engine.generate(reqs)}
+    assert by["starves"].finish_reason == "expired"
+    assert by["starves"].tokens == [] and by["starves"].ttft_ms is None
+    assert by["runs"].finish_reason == "length"
+    assert by["runs"].tokens == solo_greedy(model, params, [3, 7, 2], 6)
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_flood_shed_deterministic_and_bounded(lm):
+    """2x-capacity flood against a bounded waiting queue: shed
+    decisions are deterministic (reject-newest, same run to run), the
+    queue never exceeds the bound, and admitted requests still match
+    the solo oracle."""
+    model, params = lm
+
+    def run():
+        engine = ServeEngine(model, params, num_pages=16, page_size=4,
+                             max_batch=2, max_waiting=3)
+        reqs = [
+            Request(prompt=[2 + i, 5, 9], max_new_tokens=4,
+                    request_id=f"r{i}")
+            for i in range(9)
+        ]
+        return engine, reqs, engine.generate(reqs)
+
+    e1, reqs, r1 = run()
+    _, _, r2 = run()
+    shed1 = [r.request_id for r in r1 if r.finish_reason == "shed"]
+    shed2 = [r.request_id for r in r2 if r.finish_reason == "shed"]
+    # reject-newest with free decode slots as headroom: on an idle
+    # engine the first max_batch + max_waiting requests are kept, the
+    # rest shed — the bound engages against OVERLOAD, never against
+    # capacity the batch has free
+    assert shed1 == [f"r{i}" for i in range(5, 9)]
+    assert shed1 == shed2, "shed decisions must be deterministic"
+    assert e1.stats["peak_waiting"] <= 3 + 2  # max_waiting + max_batch
+    assert e1.stats["shed"] == 4
+    for req, res in zip(reqs, r1):
+        if res.finish_reason == "shed":
+            assert res.tokens == [] and res.ttft_ms is None
+        else:
+            assert res.tokens == solo_greedy(model, params, req.prompt, 4)
+    e1.pool.check_invariants()
+    assert e1.pool.is_idle()
+
+
+def test_starvation_freedom_under_chaos_promotion(lm):
+    """Heavy seeded chaos preemption on a tiny pool with a small
+    re-prefill budget: every admitted request still finishes (the
+    budget promotes over-evicted sequences out of the victim scans) and
+    every result stays token-identical to the solo oracle."""
+    model, params = lm
+    trng = np.random.RandomState(5)
+    engine = ServeEngine(
+        model, params, num_pages=7, page_size=4, max_batch=3,
+        prefill_token_budget=16, request_retries=2,
+        chaos_rate=0.6, chaos_rng=random.Random(5),
+    )
+    reqs = [
+        Request(prompt=trng.randint(1, V, size=(int(n),)).tolist(),
+                max_new_tokens=5, seed=i, eos_id=5, request_id=f"r{i}")
+        for i, n in enumerate([3, 7, 5, 8, 4, 6])
+    ]
+    results = engine.generate(reqs)
+    assert engine.stats["evictions"] >= 1
+    for req, res in zip(reqs, results):
+        assert res.finish_reason in ("eos", "length"), res
+        want = solo_greedy(model, params, req.prompt, req.max_new_tokens,
+                           eos=req.eos_id)
+        assert res.tokens == want, (req.request_id, res.tokens, want)
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_scheduler_expire_and_promotion_units():
+    from unicore_tpu.serve.scheduler import Scheduler
+
+    pool = PagedKVPool(num_pages=8, page_size=4)
+    sched = Scheduler(pool, max_batch=4, request_retries=1,
+                      chaos_rate=1.0, chaos_rng=random.Random(0))
+    a = sched.add(Request(prompt=[1, 2], max_new_tokens=2,
+                          deadline_ms=100.0))
+    b = sched.add(Request(prompt=[1, 2, 3], max_new_tokens=2))
+    a.enqueued_at = b.enqueued_at = 0.0
+    sched.admit()
+    assert sched.expire(now=0.05) == []      # 50ms: TTL not blown
+    assert sched.expire(now=0.2) == [a]      # 200ms > 100ms TTL
+    assert a.finish_reason == "expired"
+    pool.check_invariants()
+    # promotion: an over-budget sequence is skipped by both victim scans
+    c = sched.add(Request(prompt=[4, 5], max_new_tokens=2))
+    c.enqueued_at = 0.0
+    sched.admit()
+    assert [s is b or s is c for s in sched.running] == [True, True]
+    b.evictions = 1  # at the budget -> promoted
+    assert sched._pick_victim() is c
+    c.evictions = 1
+    # everyone promoted: organic eviction falls back to LIFO (liveness)
+    assert sched._pick_victim() is c
+    # ...but chaos preemption skips promoted sequences entirely
+    assert sched.chaos_preempt() is None
+    # bounded add: free decode slots count as headroom (an idle engine
+    # keeps max_batch + max_waiting); once the batch is saturated the
+    # waiting line holds at exactly max_waiting
+    pool2 = PagedKVPool(num_pages=16, page_size=4)
+    s2 = Scheduler(pool2, max_batch=2, max_waiting=1)
+    kept = [s2.add(Request(prompt=[1], max_new_tokens=1))
+            for _ in range(4)]
+    assert [q.finish_reason for q in kept] == [None, None, None, "shed"]
+    s2.admit()  # 2 run, 1 waits: saturated
+    late = s2.add(Request(prompt=[1], max_new_tokens=1))
+    assert late.finish_reason == "shed" and len(s2.waiting) == 1
+    pool2.check_invariants()
+
+
+def test_poisoned_request_quarantined_survivors_identical(lm):
+    """The fault-isolation oracle: one request's logits row is poisoned
+    (NaN) inside the jitted step; it finishes 'failed' with its pages
+    freed while every other request's tokens are bit-identical to solo
+    decode."""
+    model, params = lm
+    trng = np.random.RandomState(11)
+    prompts = [trng.randint(1, V, size=(n,)).tolist()
+               for n in [3, 6, 4, 7]]
+    engine = ServeEngine(model, params, num_pages=12, page_size=4,
+                         max_batch=4, poison_requests=["r1"])
+    reqs = [Request(prompt=p, max_new_tokens=6, eos_id=5,
+                    request_id=f"r{i}") for i, p in enumerate(prompts)]
+    by = {r.request_id: r for r in engine.generate(reqs)}
+    assert by["r1"].finish_reason == "failed"
+    assert by["r1"].tokens == []  # poisoned at prefill: nothing emitted
+    assert engine.stats["quarantined"] == 1
+    for i, p in enumerate(prompts):
+        if i == 1:
+            continue
+        want = solo_greedy(model, params, p, 6, eos=5)
+        assert by[f"r{i}"].tokens == want, (i, by[f"r{i}"].tokens, want)
+        assert by[f"r{i}"].finish_reason in ("eos", "length")
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_poison_mid_stream_quarantines_on_decode_boundary(lm):
+    """Poison arriving mid-stream (decode path, not prefill): the
+    victim keeps its pre-fault tokens — which still match the solo
+    prefix — and the batch survivors are untouched."""
+    model, params = lm
+    engine = ServeEngine(model, params, num_pages=12, page_size=4,
+                         max_batch=2, poison_requests=["__armed__"])
+    orig = engine._decode
+
+    def arm_later(seqs):
+        orig(seqs)
+        if engine.stats["decode_steps"] == 2:
+            engine._poison_ids = frozenset(["r0"])
+
+    engine._decode = arm_later
+    reqs = [Request(prompt=[3, 7, 2], max_new_tokens=8,
+                    request_id="r0"),
+            Request(prompt=[11, 4, 9, 8], max_new_tokens=8,
+                    request_id="r1")]
+    by = {r.request_id: r for r in engine.generate(reqs)}
+    assert by["r0"].finish_reason == "failed"
+    assert 0 < len(by["r0"].tokens) < 8
+    assert by["r0"].tokens == solo_greedy(
+        model, params, [3, 7, 2], 8)[: len(by["r0"].tokens)]
+    assert by["r1"].finish_reason == "length"
+    assert by["r1"].tokens == solo_greedy(model, params, [11, 4, 9, 8], 8)
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_host_fault_fails_inflight_not_engine(lm):
+    """A host-side step exception fails the in-flight sequences with
+    reason 'failed' and frees their pages; the engine survives and the
+    next batch decodes clean."""
+    model, params = lm
+    engine = ServeEngine(model, params, num_pages=12, page_size=4,
+                         max_batch=2)
+    orig = engine._decode
+    state = {"raised": False}
+
+    def flaky(seqs):
+        if not state["raised"] and engine.stats["decode_steps"] >= 1:
+            state["raised"] = True
+            raise RuntimeError("sampler exploded (host side)")
+        orig(seqs)
+
+    engine._decode = flaky
+    reqs = [Request(prompt=[3, 7, 2], max_new_tokens=5,
+                    request_id="a"),
+            Request(prompt=[11, 4], max_new_tokens=5, request_id="b")]
+    results = engine.generate(reqs)
+    assert [r.finish_reason for r in results] == ["failed", "failed"]
+    assert engine.stats["host_faults"] == 1
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+    # the engine is still servable, token-identically
+    [clean] = engine.generate(
+        [Request(prompt=[6, 2, 9], max_new_tokens=5,
+                 request_id="clean")])
+    assert clean.tokens == solo_greedy(model, params, [6, 2, 9], 5)
+
+
+def test_capacity_failfast_instead_of_livelock(lm):
+    """Satellite fix: a request whose prompt+generated prefix can never
+    fit the pool terminates with reason 'capacity' (counted in stats)
+    instead of cycling the preempt-retry recovery forever; neighbors
+    are unaffected."""
+    model, params = lm
+    engine = ServeEngine(model, params, num_pages=4, page_size=4,
+                         max_batch=2)  # 3 usable pages = 12 slots
+    sched = engine.scheduler
+    good = sched.add(Request(prompt=[3, 7, 2], max_new_tokens=3,
+                             request_id="fits"))
+    bad = sched.add(Request(prompt=[2] * 8, max_new_tokens=4,
+                            request_id="huge"))
+    good.enqueued_at = bad.enqueued_at = 0.0
+    # simulate a preempted-and-resumed request whose prefix outgrew the
+    # whole pool (16 tokens -> 4 pages > 3 usable)
+    bad.generated = [1] * 8
+    engine._run_to_completion(sched)
+    assert bad.finish_reason == "capacity"
+    assert engine.stats["capacity_failfast"] == 1
+    assert good.finish_reason == "length"
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
+def test_graceful_drain_sheds_within_timeout(lm):
+    """SIGTERM-equivalent drain with drain_timeout=0: admission closes,
+    waiting requests shed immediately, running ones shed at the next
+    boundary past the deadline — partial tokens preserved (and still
+    oracle-exact), pool idle, drain report emitted.  The engine stays
+    drained afterwards."""
+    import signal as _signal
+
+    from unicore_tpu.resilience.preemption import GracefulShutdown
+
+    model, params = lm
+    sd = GracefulShutdown()  # not installed: programmatic trigger
+    engine = ServeEngine(model, params, num_pages=16, page_size=4,
+                         max_batch=2, shutdown=sd, drain_timeout=0.0)
+    orig = engine._decode
+
+    def trip(seqs):
+        orig(seqs)
+        if engine.stats["decode_steps"] == 2:
+            sd.request(_signal.SIGTERM)
+
+    engine._decode = trip
+    reqs = [Request(prompt=[3 + i, 7, 2], max_new_tokens=10,
+                    request_id=f"r{i}") for i in range(4)]
+    results = engine.generate(reqs)
+    assert all(r.finish_reason == "shed" for r in results)
+    report = engine.drain_report
+    assert report and report["requested"] and report["signal"] == "SIGTERM"
+    assert report["pool_idle"] and engine.pool.is_idle()
+    engine.pool.check_invariants()
+    for req, res in zip(reqs, results):
+        if res.tokens:
+            want = solo_greedy(model, params, req.prompt, 10)
+            assert res.tokens == want[: len(res.tokens)]
+    # a drained engine sheds everything submitted later
+    [late] = engine.generate([Request(prompt=[5, 5], max_new_tokens=2,
+                                      request_id="late")])
+    assert late.finish_reason == "shed"
+
+
+def test_graceful_drain_finishes_inflight_within_timeout(lm):
+    """With a generous drain_timeout, in-flight requests run their tail
+    out and finish normally (solo-oracle-exact); only the never-admitted
+    waiting request is shed."""
+    from unicore_tpu.resilience.preemption import GracefulShutdown
+
+    model, params = lm
+    sd = GracefulShutdown()
+    engine = ServeEngine(model, params, num_pages=16, page_size=4,
+                         max_batch=2, shutdown=sd, drain_timeout=60.0)
+    orig = engine._decode
+
+    def trip(seqs):
+        orig(seqs)
+        if engine.stats["decode_steps"] == 1:
+            sd.request()
+
+    engine._decode = trip
+    reqs = [Request(prompt=[3, 7, 2], max_new_tokens=6,
+                    request_id="r0"),
+            Request(prompt=[11, 4, 9], max_new_tokens=6,
+                    request_id="r1"),
+            Request(prompt=[6, 2], max_new_tokens=6, request_id="r2")]
+    by = {r.request_id: r for r in engine.generate(reqs)}
+    assert by["r2"].finish_reason == "shed"  # never admitted
+    for rid, prompt in (("r0", [3, 7, 2]), ("r1", [11, 4, 9])):
+        assert by[rid].finish_reason == "length"
+        assert by[rid].tokens == solo_greedy(model, params, prompt, 6)
+    assert engine.drain_report["deadline_exceeded"] is False
+    engine.pool.check_invariants()
+    assert engine.pool.is_idle()
+
+
 # -- CLI -------------------------------------------------------------------
 
 
@@ -406,6 +780,7 @@ def test_serve_cli_demo(tmp_path):
         "--demo", "--num-requests", "3", "--max-new-tokens", "5",
         "--page-size", "4", "--num-pages", "16", "--max-batch", "3",
         "--prompt-len-range", "3,9", "--json", str(out),
+        "--max-waiting", "8", "--drain-timeout", "5",
     ])
     assert rc == 0
     report = json.loads(out.read_text())
@@ -414,3 +789,8 @@ def test_serve_cli_demo(tmp_path):
         assert res["finish_reason"] in ("eos", "length", "capacity")
         assert len(res["tokens"]) == 5
     assert report["stats"]["generated_tokens"] == 15
+    # robustness surface: no drain happened, the pool ended clean, and
+    # the lifecycle counters rode along at zero
+    assert report["drain"] is None and report["pool_clean"] is True
+    for key in ("shed", "expired", "quarantined", "capacity_failfast"):
+        assert report["stats"][key] == 0, (key, report["stats"])

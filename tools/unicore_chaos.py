@@ -29,9 +29,30 @@ Fault-injection legs (exercising the in-loop anomaly guard end to end):
                          run checkpointed-and-exited cleanly (exit 0)
                          before resuming.
 
+Serve-tier legs (``--serve``, ISSUE 7 — the same oracle discipline
+applied to the continuous-batching engine):
+
+  --serve --inject poison:K  poison request K's logits row INSIDE the
+                             jitted step (UNICORE_TPU_CHAOS_SERVE_POISON
+                             — the per-request anomaly-guard pattern)
+                             and assert it finishes ``failed`` while
+                             every SURVIVOR's tokens are bit-identical
+                             to a solo-engine oracle run;
+  --serve --graceful         SIGTERM a ``unicore-serve`` subprocess
+                             mid-stream (progress-file trigger) and
+                             assert it drains: exit 0, drain report in
+                             the JSON output, zero leaked pool pages;
+  --serve --flood            seeded 2x-capacity overload: the waiting
+                             queue stays bounded, shed decisions are
+                             deterministic run to run, and every
+                             ADMITTED request finishes with tokens
+                             bit-identical to the solo oracle (no
+                             starvation under chaos preemption).
+
 CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
-(SIGKILL at a random step + one torn shard + bit-exact resume) and the
-``--inject nonfinite:4`` leg.  Exit code 0 iff every assertion holds.
+(SIGKILL at a random step + one torn shard + bit-exact resume), the
+``--inject nonfinite:4`` leg, and the serve poison + graceful legs.
+Exit code 0 iff every assertion holds.
 """
 
 import argparse
@@ -285,6 +306,267 @@ def compare_trajectories(oracle, chaos_records):
 
 
 # ----------------------------------------------------------------------
+# serve-tier chaos (ISSUE 7)
+# ----------------------------------------------------------------------
+
+SERVE_POOL = dict(num_pages=24, page_size=4, max_batch=4)
+
+
+def _serve_demo_setup(seed, num_requests=6, max_new=8):
+    """Seeded demo model + mixed-length requests (greedy, so every
+    comparison below is exact token identity, no sampling slack)."""
+    import numpy as np
+
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.scheduler import Request
+
+    model, params = _demo_model(seed)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(num_requests):
+        n = int(rng.integers(3, 17))
+        prompt = [int(t) for t in
+                  rng.integers(1, model.vocab_size, size=(n,))]
+        reqs.append(Request(
+            prompt=prompt, max_new_tokens=max_new, seed=seed + i,
+            request_id=f"demo-{i}",
+        ))
+    return model, params, reqs
+
+
+_SOLO_ENGINES = {}
+
+
+def _solo_tokens(model, params, req):
+    """The oracle: the same request, alone, on an engine with a pool
+    big enough that no eviction/continuous-batching effect can touch
+    it.  One engine is cached per model so the jitted prefill/decode
+    executables compile once, not once per compared survivor — results
+    are reproducible from the request alone (sampling is keyed by
+    absolute (seed, step) and prefill rewrites every allocated page),
+    so back-to-back solo runs on one engine are independent."""
+    from unicore_tpu.serve.engine import ServeEngine
+
+    engine = _SOLO_ENGINES.get(id(model))
+    if engine is None:
+        engine = _SOLO_ENGINES[id(model)] = ServeEngine(
+            model, params, num_pages=64, page_size=4, max_batch=1)
+    [res] = engine.generate([req])
+    return res.tokens
+
+
+def serve_poison_leg(args, report):
+    """Poisoned-request injection: the poisoned row is quarantined
+    (``failed``, pages freed) and every survivor is bit-identical to
+    its solo oracle."""
+    from unicore_tpu.serve.engine import ServeEngine
+
+    at = int(args.inject.partition(":")[2])
+    model, params, reqs = _serve_demo_setup(args.seed)
+    if not 0 <= at < len(reqs):
+        raise SystemExit(f"poison index {at} outside 0..{len(reqs) - 1}")
+    poisoned_id = f"demo-{at}"
+    print(f"[chaos] serve poison leg: NaN'ing {poisoned_id}'s logits "
+          f"row inside the jitted step", flush=True)
+    engine = ServeEngine(model, params, poison_requests=[poisoned_id],
+                         **SERVE_POOL)
+    results = engine.generate(reqs)
+    by_id = {r.request_id: r for r in results}
+    bad = by_id[poisoned_id]
+    engine.pool.check_invariants()
+    mismatches = []
+    for req in reqs:
+        if req.request_id == poisoned_id:
+            continue
+        want = _solo_tokens(model, params, req)
+        got = by_id[req.request_id].tokens
+        if got != want:
+            mismatches.append({"request": req.request_id,
+                               "got": got, "want": want})
+    report["poison"] = {
+        "request": poisoned_id,
+        "failed": bad.finish_reason == "failed",
+        "quarantined": engine.stats["quarantined"],
+        "survivors_exact": not mismatches,
+        "mismatches": mismatches[:5],
+        "pool_idle": engine.pool.is_idle(),
+    }
+    if bad.finish_reason != "failed":
+        raise RuntimeError(
+            f"poisoned request finished {bad.finish_reason!r}, not "
+            f"'failed' — the quarantine did not fire"
+        )
+    if mismatches:
+        raise RuntimeError(
+            f"poison leg: {len(mismatches)} survivor(s) diverged from "
+            f"the solo oracle: {mismatches[:3]}"
+        )
+    if not report["poison"]["pool_idle"]:
+        raise RuntimeError("poison leg: pool pages leaked")
+
+
+def serve_flood_leg(args, report):
+    """Seeded 2x-capacity overload: bounded queue, deterministic shed
+    decisions, and no admitted request starves (tokens still solo-
+    oracle-exact under chaos preemption)."""
+    from unicore_tpu.serve.engine import ServeEngine
+
+    max_waiting, retries = 4, 4
+    capacity = SERVE_POOL["max_batch"] + max_waiting
+    model, params, reqs = _serve_demo_setup(
+        args.seed, num_requests=2 * capacity)
+
+    def run():
+        engine = ServeEngine(
+            model, params, max_waiting=max_waiting,
+            request_retries=retries, chaos_rate=0.3,
+            chaos_rng=random.Random(args.seed), **SERVE_POOL,
+        )
+        return engine, engine.generate(reqs)
+
+    print(f"[chaos] serve flood leg: {len(reqs)} requests into "
+          f"capacity {capacity} (twice, asserting determinism)",
+          flush=True)
+    e1, r1 = run()
+    e2, r2 = run()
+    shed1 = [r.request_id for r in r1 if r.finish_reason == "shed"]
+    shed2 = [r.request_id for r in r2 if r.finish_reason == "shed"]
+    starved = [r.request_id for r in r1
+               if r.finish_reason not in
+               ("eos", "length", "capacity", "shed")]
+    mismatches = []
+    for req, res in zip(reqs, r1):
+        if res.finish_reason == "shed":
+            continue
+        want = _solo_tokens(model, params, req)
+        if res.tokens != want:
+            mismatches.append({"request": req.request_id,
+                               "got": res.tokens, "want": want})
+    # free decode slots count as headroom, so the hard line on the
+    # waiting queue is max_waiting + max_batch (saturated: max_waiting)
+    waiting_bound = max_waiting + SERVE_POOL["max_batch"]
+    report["flood"] = {
+        "requests": len(reqs), "max_waiting": max_waiting,
+        "waiting_bound": waiting_bound,
+        "shed": shed1, "shed_deterministic": shed1 == shed2,
+        "peak_waiting": e1.stats["peak_waiting"],
+        "max_evictions": max([r.evictions for r in r1], default=0),
+        "starved": starved, "admitted_exact": not mismatches,
+        "pool_idle": e1.pool.is_idle() and e2.pool.is_idle(),
+    }
+    if not shed1:
+        raise RuntimeError("flood leg: nothing was shed at 2x capacity "
+                           "— the bound is not engaging")
+    if shed1 != shed2:
+        raise RuntimeError(
+            f"flood leg: shed decisions diverged run to run: "
+            f"{shed1} vs {shed2}"
+        )
+    if e1.stats["peak_waiting"] > waiting_bound:
+        raise RuntimeError(
+            f"flood leg: waiting queue grew to "
+            f"{e1.stats['peak_waiting']} past the bound {waiting_bound}"
+        )
+    if starved or mismatches:
+        raise RuntimeError(
+            f"flood leg: starved={starved} mismatches={mismatches[:3]}"
+        )
+
+
+def serve_graceful_leg(args, report, workdir):
+    """SIGTERM a real ``unicore-serve`` run mid-stream: it must drain
+    (exit 0), emit a drain report, and leak zero pool pages."""
+    progress = os.path.join(workdir, "serve_progress")
+    out_json = os.path.join(workdir, "serve_drain.json")
+    drain_timeout = 5.0
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "unicore_serve.py"),
+        "--demo", "--num-requests", "8", "--max-new-tokens", "120",
+        "--prompt-len-range", "3,9", "--seed", str(args.seed),
+        "--page-size", "4", "--num-pages", "32", "--max-batch", "4",
+        "--drain-timeout", str(drain_timeout),
+        "--progress-file", progress, "--json", out_json,
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    print("[chaos] serve graceful leg: SIGTERM after 3 decode steps",
+          flush=True)
+    out, _ = run_and_kill(
+        cmd, env, progress, graceful=True,
+        trigger=lambda: traj_lines(progress) >= 3,
+        desc="3 serve decode steps", timeout=600,
+    )
+    if not os.path.exists(out_json):
+        raise RuntimeError(
+            "graceful serve leg: no JSON report after drain:\n"
+            + out[-3000:]
+        )
+    with open(out_json) as f:
+        r = json.load(f)
+    drain = r.get("drain")
+    report["graceful_serve"] = {
+        "exit_code": 0,
+        "drain": drain,
+        "pool_clean": bool(r.get("pool_clean")),
+        "reasons": sorted({x["finish_reason"] for x in r["results"]}),
+        "generated_tokens": r["stats"]["generated_tokens"],
+        "shed": r["stats"]["shed"],
+    }
+    if not (drain and drain.get("requested")):
+        raise RuntimeError(
+            f"graceful serve leg: no drain report in the output: {r}"
+        )
+    if not r.get("pool_clean"):
+        raise RuntimeError("graceful serve leg: pool pages leaked "
+                           "(check_invariants/is_idle failed)")
+    if r["stats"]["generated_tokens"] >= 8 * 120:
+        raise RuntimeError(
+            "graceful serve leg: the run finished its whole workload — "
+            "the SIGTERM was not mid-stream"
+        )
+
+
+def serve_main(args):
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    workdir = args.workdir or tempfile.mkdtemp(
+        prefix="unicore_chaos_serve_")
+    os.makedirs(workdir, exist_ok=True)
+    report = {"mode": "serve", "workdir": workdir, "seed": args.seed}
+    legs = []
+    if args.inject:
+        kind = args.inject.partition(":")[0]
+        if kind != "poison":
+            raise SystemExit(
+                f"--serve supports --inject poison:K, got {args.inject!r}"
+            )
+        serve_poison_leg(args, report)
+        legs.append("poison")
+    if args.flood:
+        serve_flood_leg(args, report)
+        legs.append("flood")
+    if args.graceful:
+        serve_graceful_leg(args, report, workdir)
+        legs.append("graceful")
+    if not legs:
+        raise SystemExit(
+            "--serve needs at least one of --inject poison:K, --flood, "
+            "--graceful"
+        )
+    report["legs"] = legs
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if not args.keep and args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(f"[chaos] OK: serve legs {legs} all held")
+    return 0
+
+
+# ----------------------------------------------------------------------
 # main
 # ----------------------------------------------------------------------
 
@@ -334,6 +616,15 @@ def build_parser():
                         "at the next step boundary (no swallowed IO), and "
                         "the resume must be bit-exact from the last intact "
                         "checkpoint")
+    p.add_argument("--serve", action="store_true",
+                   help="serve-tier chaos instead of training: combine "
+                        "with --inject poison:K (quarantine + survivor "
+                        "oracle), --graceful (mid-stream SIGTERM drain), "
+                        "and/or --flood (2x-capacity overload)")
+    p.add_argument("--flood", action="store_true",
+                   help="(with --serve) seeded 2x-capacity overload "
+                        "flood: bounded queue, deterministic sheds, no "
+                        "starvation")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")
@@ -345,6 +636,8 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.serve:
+        return serve_main(args)
     import tempfile
 
     from unicore_tpu.resilience import read_trajectory

@@ -37,6 +37,17 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   async APIs exist precisely for these: the ``--stats-lag`` pipeline
   defers the stats fetch, ``stage_batches`` double-buffers input, and
   the background checkpoint writer streams saves off the step path.
+- UL109 unbounded-queue-growth: ``.append``/``.appendleft``/
+  ``.insert`` onto a collection inside a SERVE LOOP (any
+  ``for``/``while`` whose body drives request scheduling —
+  ``admit``/``prepare_decode``/``serve_step``/``poll_requests``)
+  with no bound check (a ``len(...)`` comparison on the same
+  collection) or shed path (``pop``/``popleft``/``clear``/``remove``
+  or a ``*shed*`` call) anywhere in the loop.  Under sustained
+  overload an unbounded queue grows until every queued request has
+  blown its deadline and the host OOMs — the serve tier's bounded
+  ``max_waiting`` + deterministic shedding exists precisely so
+  backpressure is visible to callers instead.
 
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
@@ -113,6 +124,14 @@ _UL108_SYNC_TAILS = {"device_get", "block_until_ready"}
 # the step path only ever pays the device->host capture
 _UL108_SAVE_TAILS = {"save_checkpoint", "write_checkpoint", "atomic_save"}
 
+# UL109: a loop is a SERVE LOOP iff its body drives request scheduling
+_SERVE_LOOP_MARKERS = {"admit", "prepare_decode", "serve_step",
+                       "poll_requests"}
+# UL109: growth calls that need a visible bound or shed path
+_UL109_GROW_TAILS = {"append", "appendleft", "insert"}
+# UL109: calls on the SAME collection that count as a drain/shed path
+_UL109_DRAIN_TAILS = {"pop", "popleft", "popitem", "clear", "remove"}
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -140,6 +159,7 @@ class _ModuleLint(ast.NodeVisitor):
         self.jitted_names = set()
         self._with_seed_depth = 0
         self._step_loop_depth = 0
+        self._serve_loop_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
 
@@ -485,29 +505,91 @@ class _ModuleLint(ast.NodeVisitor):
                 )
                 return
 
-    # -- UL108 ---------------------------------------------------------
+    # -- UL108 / UL109 -------------------------------------------------
 
-    def _loop_is_step_loop(self, loop):
-        """A for/while whose body calls ``train_step`` at this nesting
-        level.  Nested function defs are excluded (a closure defined in
-        a loop does not run per iteration) and so are NESTED loops: in
-        ``for epoch: (for batch: train_step(batch)); device_get(...)``
-        only the inner loop is the step loop — the epoch-level sync
-        runs once per epoch, which is exactly the sanctioned
-        fetch-at-real-boundaries pattern, not a per-step stall."""
+    def _loop_body_calls(self, loop, markers, skip_nested_loops=True):
+        """A for/while whose body calls one of ``markers``.  Nested
+        function defs are always excluded (a closure defined in a loop
+        does not run per iteration).  With ``skip_nested_loops`` (the
+        UL108 semantics) NESTED loops are too: in ``for epoch: (for
+        batch: train_step(batch)); device_get(...)`` only the inner
+        loop is the step loop — the epoch-level sync runs once per
+        epoch, which is exactly the sanctioned
+        fetch-at-real-boundaries pattern, not a per-step stall.  UL109
+        passes False: an outer ``while True`` that appends to a queue
+        and drives ``admit()`` from a nested drain loop still grows
+        the queue once per serve cycle, so the OUTER loop is the serve
+        loop and its whole subtree is the growth-audit scope."""
         stack = list(loop.body) + list(getattr(loop, "orelse", []) or [])
         while stack:
             sub = stack.pop()
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                ast.Lambda, ast.For, ast.AsyncFor,
-                                ast.While)):
+                                ast.Lambda)):
+                continue
+            if skip_nested_loops and isinstance(
+                    sub, (ast.For, ast.AsyncFor, ast.While)):
                 continue
             if isinstance(sub, ast.Call):
                 chain = _attr_chain(sub.func)
-                if chain and chain.split(".")[-1] in _STEP_LOOP_MARKERS:
+                if chain and chain.split(".")[-1] in markers:
                     return True
             stack.extend(ast.iter_child_nodes(sub))
         return False
+
+    def _loop_is_step_loop(self, loop):
+        return self._loop_body_calls(loop, _STEP_LOOP_MARKERS)
+
+    def _loop_is_serve_loop(self, loop):
+        return self._loop_body_calls(loop, _SERVE_LOOP_MARKERS,
+                                     skip_nested_loops=False)
+
+    def _check_unbounded_growth(self, loop):
+        """UL109 over one outermost serve loop: every
+        ``.append``/``.appendleft``/``.insert`` onto a named collection
+        must be matched — anywhere in the same loop — by a bound check
+        (``len(<collection>)``, e.g. against a ``max_waiting``) or a
+        drain/shed path (``pop``/``popleft``/``clear``/``remove`` on
+        it, or any ``*shed*`` call).  Closures defined in the loop do
+        not run per iteration and are skipped, mirroring UL108."""
+        grows = []
+        sanctioned = set()
+        shed_anywhere = False
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain is not None:
+                    parts = chain.split(".")
+                    tail, recv = parts[-1], ".".join(parts[:-1])
+                    if isinstance(sub.func, ast.Attribute) and recv:
+                        if tail in _UL109_GROW_TAILS:
+                            grows.append((sub, recv))
+                        elif tail in _UL109_DRAIN_TAILS:
+                            sanctioned.add(recv)
+                    if "shed" in tail.lower():
+                        shed_anywhere = True
+                if (isinstance(sub.func, ast.Name)
+                        and sub.func.id == "len" and sub.args):
+                    arg = _attr_chain(sub.args[0])
+                    if arg:
+                        sanctioned.add(arg)
+            stack.extend(ast.iter_child_nodes(sub))
+        for node, recv in grows:
+            if recv in sanctioned or shed_anywhere:
+                continue
+            self.emit(
+                "UL109", "unbounded-queue-growth", "error", node,
+                f"'{recv}' grows inside a serve/scheduler loop with no "
+                f"bound check or shed path in sight — under sustained "
+                f"overload it grows until every queued request has "
+                f"blown its deadline and the host OOMs; bound it "
+                f"(len({recv}) vs a max) and shed deterministically "
+                f"like the serve tier's max_waiting",
+            )
 
     def _check_sync_in_step_loop(self, node):
         if self._step_loop_depth == 0:
@@ -536,11 +618,22 @@ class _ModuleLint(ast.NodeVisitor):
 
     def _visit_loop(self, node):
         is_step = self._loop_is_step_loop(node)
+        if (self._serve_loop_depth == 0
+                and self._loop_is_serve_loop(node)):
+            # scan once from the OUTERMOST serve loop: its subtree
+            # covers nested loops' growth sites and bound checks alike
+            self._check_unbounded_growth(node)
+            self._serve_loop_depth += 1
+            is_serve = True
+        else:
+            is_serve = False
         if is_step:
             self._step_loop_depth += 1
         self.generic_visit(node)
         if is_step:
             self._step_loop_depth -= 1
+        if is_serve:
+            self._serve_loop_depth -= 1
 
     def visit_For(self, node):
         self._visit_loop(node)
@@ -549,11 +642,13 @@ class _ModuleLint(ast.NodeVisitor):
         self._visit_loop(node)
 
     def _visit_scope_reset(self, node):
-        # a function/lambda DEFINED inside a step loop does not run per
-        # iteration — its body is a fresh scope for UL108
+        # a function/lambda DEFINED inside a step/serve loop does not
+        # run per iteration — its body is a fresh scope for UL108/UL109
         saved, self._step_loop_depth = self._step_loop_depth, 0
+        saved_serve, self._serve_loop_depth = self._serve_loop_depth, 0
         self.generic_visit(node)
         self._step_loop_depth = saved
+        self._serve_loop_depth = saved_serve
 
     def visit_FunctionDef(self, node):
         self._visit_scope_reset(node)

@@ -62,6 +62,19 @@ class GracefulShutdown:
         self.uninstall()
         return False
 
+    # -- programmatic trigger ------------------------------------------
+
+    def request(self, signum=None):
+        """Trigger the shutdown flag without a delivered signal — the
+        serve engine's :meth:`request_drain` and the bench drain timer
+        use this so drain behavior is testable (and measurable) without
+        process-level signal plumbing.  Same contract as a signal: only
+        the flag flips; all real work happens at the caller's next
+        step boundary."""
+        self.requested = True
+        if signum is not None:
+            self.signum = signum
+
     # -- handler -------------------------------------------------------
 
     def _handle(self, signum, frame):

@@ -21,6 +21,8 @@ _EXPORTS = {
     "sample_token": ("unicore_tpu.serve.sampling", "sample_token"),
     "sample_tokens": ("unicore_tpu.serve.sampling", "sample_tokens"),
     "step_key": ("unicore_tpu.serve.sampling", "step_key"),
+    "finite_rows": ("unicore_tpu.serve.sampling", "finite_rows"),
+    "reject_newest": ("unicore_tpu.serve.scheduler", "reject_newest"),
 }
 
 __all__ = sorted(_EXPORTS)

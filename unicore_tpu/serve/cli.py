@@ -56,6 +56,35 @@ def make_parser():
     eng.add_argument("--num-pages", type=int, default=64)
     eng.add_argument("--max-batch", type=int, default=8)
     eng.add_argument("--prefill-token-budget", type=int, default=512)
+    rob = p.add_argument_group(
+        "robustness (docs/serving.md#robustness)")
+    rob.add_argument("--max-waiting", type=int, default=None,
+                     help="bound on the waiting queue (free decode "
+                          "slots count as headroom); overflow is SHED "
+                          "deterministically (reject-newest) instead of "
+                          "growing without bound (default: unbounded)")
+    rob.add_argument("--deadline-ms", type=float, default=None,
+                     help="TTL applied to every request: blown requests "
+                          "finish 'expired' and free their pages at the "
+                          "next step boundary")
+    from unicore_tpu.serve.scheduler import DEFAULT_REQUEST_RETRIES
+
+    rob.add_argument("--request-retries", type=int,
+                     default=DEFAULT_REQUEST_RETRIES,
+                     help="per-request re-prefill budget: after this many "
+                          "evictions a sequence is promoted and no longer "
+                          "preempted (starvation protection) (default: "
+                          f"{DEFAULT_REQUEST_RETRIES})")
+    rob.add_argument("--drain-timeout", type=float, default=30.0,
+                     help="seconds in-flight work gets to finish after "
+                          "SIGTERM before it is shed (graceful drain)")
+    rob.add_argument("--step-timeout", type=float, default=0.0,
+                     help="arm a StepWatchdog around every prefill/decode "
+                          "dispatch; a hung step dumps stacks + queue "
+                          "depths and exits 87 (0 = off)")
+    rob.add_argument("--progress-file", default=None,
+                     help="append one line per decode step (the chaos "
+                          "harness's mid-stream SIGTERM trigger)")
     p.add_argument("--json", dest="json_out",
                    help="write the report here instead of stdout")
     return p
@@ -131,6 +160,7 @@ def _demo_requests(args, vocab, rng):
             max_new_tokens=args.max_new_tokens,
             temperature=args.temperature, top_k=args.top_k,
             seed=args.seed + i, request_id=f"demo-{i}",
+            deadline_ms=args.deadline_ms,
         ))
     return reqs
 
@@ -148,6 +178,7 @@ def _file_requests(args, path):
                 prompt=toks, max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_k=args.top_k,
                 seed=args.seed + i, request_id=f"req-{i}",
+                deadline_ms=args.deadline_ms,
             ))
     return reqs
 
@@ -185,16 +216,32 @@ def main(argv=None):
                 "dictionary for this checkpoint?"
             )
 
+    from unicore_tpu.resilience.preemption import GracefulShutdown
+
+    # SIGTERM/SIGINT -> graceful drain: admission closes at the next
+    # step boundary, in-flight work gets --drain-timeout to finish or
+    # is shed, and the process still writes its report and exits 0
+    shutdown = GracefulShutdown().install()
     engine = ServeEngine(
         model, params, num_pages=args.num_pages, page_size=args.page_size,
         max_batch=args.max_batch,
         prefill_token_budget=args.prefill_token_budget,
+        max_waiting=args.max_waiting,
+        request_retries=args.request_retries,
+        drain_timeout=args.drain_timeout, shutdown=shutdown,
+        step_timeout=args.step_timeout,
+        progress_path=args.progress_file,
     )
     logger.info(
         "serving %d request(s): pool %d pages x %d slots, max batch %d",
         len(requests), args.num_pages, args.page_size, args.max_batch,
     )
-    results = engine.generate(requests)
+    try:
+        results = engine.generate(requests)
+    finally:
+        shutdown.uninstall()
+    pool_clean = engine.pool.is_idle()
+    engine.pool.check_invariants()
     report = {
         "results": [
             {
@@ -202,14 +249,33 @@ def main(argv=None):
                 "prompt": r.prompt,
                 "tokens": r.tokens,
                 "finish_reason": r.finish_reason,
-                "ttft_ms": round(r.ttft_ms, 2),
+                "ttft_ms": (None if r.ttft_ms is None
+                            else round(r.ttft_ms, 2)),
                 "evictions": r.evictions,
             }
             for r in results
         ],
         "stats": {k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in engine.stats.items()},
+        "drain": engine.drain_report,
+        "pool_clean": pool_clean,
     }
+    if shutdown.requested and engine.drain_report is None:
+        # the signal landed after the last step boundary: nothing was
+        # in flight, but the operator still gets a drain record with
+        # the same shape (and signal) a mid-stream drain reports
+        import signal as _signal
+
+        report["drain"] = {
+            "requested": True,
+            "signal": (None if shutdown.signum is None
+                       else _signal.Signals(shutdown.signum).name),
+            "drain_ms": 0.0,
+            "drain_timeout_s": args.drain_timeout,
+            "shed": 0, "expired": 0,
+            "deadline_exceeded": False,
+            "pool_idle": pool_clean,
+        }
     text = json.dumps(report, indent=2)
     if args.json_out:
         with open(args.json_out, "w") as f:

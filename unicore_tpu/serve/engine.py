@@ -12,10 +12,38 @@ anyway to drive the scheduler.
 Metrics: per-request TTFT, aggregate decode tokens/sec, pool occupancy
 (peak + per-step into ``unicore_tpu.metrics`` when an aggregation
 context is active).
+
+Robustness (ISSUE 7), layered on the ``resilience/`` machinery:
+
+- **Per-request fault isolation.**  Every jitted step also returns a
+  per-row finite-logits flag (:func:`~unicore_tpu.serve.sampling.
+  finite_rows` — the anomaly-guard pattern applied per request); a
+  poisoned row is QUARANTINED: it finishes ``"failed"``, its pages are
+  freed, and the rest of the batch continues token-identically.  A
+  host-side step exception (sampler fault, bad assembly) likewise
+  fails only the in-flight sequences — the engine survives unless the
+  fault consumed the donated pool buffers.
+- **Graceful drain.**  Wire a :class:`~unicore_tpu.resilience.
+  preemption.GracefulShutdown` in (or call :meth:`request_drain`):
+  admission closes at the next step boundary, waiting requests are
+  shed, running ones get ``drain_timeout`` seconds to finish before
+  they are shed too, and :attr:`drain_report` records the outcome —
+  the pool ends idle, nothing leaks.
+- **Watchdog.**  ``step_timeout > 0`` arms a
+  :class:`~unicore_tpu.resilience.watchdog.StepWatchdog` around every
+  prefill/decode dispatch, with a context hook naming the stuck phase
+  and the queue depths before the process exits.
+- **Capacity fail-fast.**  A request whose prompt+generated prefix can
+  never fit the pool terminates with reason ``"capacity"`` instead of
+  cycling the preempt-retry recovery forever.
 """
 
+import contextlib
 import dataclasses
+import logging
+import os
 import time
+from collections import deque
 from typing import List, Optional
 
 import numpy as np
@@ -27,8 +55,10 @@ from unicore_tpu.logging import metrics
 
 from .attention import PagedMeta
 from .kv_pool import PagedKVPool, PoolExhausted
-from .sampling import sample_tokens, step_keys
-from .scheduler import Scheduler
+from .sampling import finite_rows, sample_tokens, step_keys
+from .scheduler import DEFAULT_REQUEST_RETRIES, Scheduler
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -36,8 +66,9 @@ class ServeResult:
     request_id: Optional[str]
     prompt: List[int]
     tokens: List[int]          # generated tokens (eos included if hit)
-    finish_reason: str         # "eos" | "length" | "capacity"
-    ttft_ms: float
+    # "eos" | "length" | "capacity" | "expired" | "shed" | "failed"
+    finish_reason: str
+    ttft_ms: Optional[float]   # None when no token was ever emitted
     evictions: int
 
 
@@ -61,7 +92,10 @@ class ServeEngine:
 
     def __init__(self, model, params, *, num_pages=64, page_size=16,
                  max_batch=8, prefill_token_budget=512, max_context=None,
-                 chaos_rate=0.0, chaos_rng=None):
+                 chaos_rate=0.0, chaos_rng=None, max_waiting=None,
+                 request_retries=DEFAULT_REQUEST_RETRIES,
+                 drain_timeout=30.0, shutdown=None, step_timeout=0.0,
+                 clock=None, poison_requests=None, progress_path=None):
         self.model = model
         self.params = params
         self.page_size = int(page_size)
@@ -79,6 +113,7 @@ class ServeEngine:
             self.pool, self.max_batch,
             prefill_token_budget=self.prefill_token_budget,
             chaos_rate=chaos_rate, chaos_rng=chaos_rng,
+            max_waiting=max_waiting, request_retries=request_retries,
         )
         self.pages = self._init_pages()
         # the prompt-length -> compile-bucket map, overridable so the
@@ -87,11 +122,40 @@ class ServeEngine:
         self.bucket_fn = _pow2_bucket
         self._prefill_fns = {}
         self._decode_fns = {}
+        # one host clock for enqueue stamps, TTFT, deadlines, and the
+        # drain timer — injectable so deadline/drain tests are exact
+        self._clock = clock or time.perf_counter
+        self.drain_timeout = float(drain_timeout)
+        self.shutdown = shutdown
+        self.drain_report = None
+        self._drain_flag = False
+        self._drain_started = None
+        self.progress_path = progress_path
+        # seeded poisoned-request injection (chaos harness): listed
+        # request ids get their sampled-from logits row NaN'd INSIDE
+        # the jitted step.  Trace-time gated — with no ids the
+        # production program carries no injection code at all.
+        if poison_requests is None:
+            env = os.environ.get("UNICORE_TPU_CHAOS_SERVE_POISON", "")
+            poison_requests = [s for s in env.split(",") if s]
+        self._poison_ids = frozenset(poison_requests or ())
+        self._chaos_poison = bool(self._poison_ids)
+        self.watchdog = None
+        if step_timeout and float(step_timeout) > 0:
+            from unicore_tpu.resilience.watchdog import StepWatchdog
+
+            self.watchdog = StepWatchdog(
+                float(step_timeout), context=self._watchdog_context
+            )
+        # recent per-decode-step wall latencies (bench p99 feeds on it)
+        self.decode_ms = deque(maxlen=4096)
         self.stats = {
             "prefills": 0, "decode_steps": 0, "decode_tokens": 0,
             "generated_tokens": 0, "peak_pool_occupancy": 0.0,
             "decode_time_s": 0.0, "wall_time_s": 0.0,
             "pool_exhausted_recoveries": 0,
+            "shed": 0, "expired": 0, "quarantined": 0, "host_faults": 0,
+            "capacity_failfast": 0, "peak_waiting": 0,
         }
 
     # -- pool buffers --------------------------------------------------
@@ -149,10 +213,11 @@ class ServeEngine:
         fn = self._decode_fns.get(sampling)
         if fn is None:
             model, page_size = self.model, self.page_size
+            poison_gate = self._chaos_poison
 
             def step(params, pages, tokens, positions, page_table,
                      slot_mapping, lengths, seeds, steps, temperature,
-                     top_k):
+                     top_k, poison=None):
                 meta = PagedMeta(
                     page_table=page_table, slot_mapping=slot_mapping,
                     lengths=lengths, page_size=page_size,
@@ -162,11 +227,17 @@ class ServeEngine:
                     decode=True, positions=positions, paged=meta,
                     mutable=["pagedkv"],
                 )
+                rows = logits[:, -1]
+                if poison_gate:  # chaos injection, gated at trace time
+                    rows = jnp.where(
+                        poison[:, None], jnp.asarray(jnp.nan, rows.dtype),
+                        rows,
+                    )
+                ok = finite_rows(rows)
                 toks = self._pick_tokens(
-                    logits[:, -1], seeds, steps, temperature, top_k,
-                    sampling,
+                    rows, seeds, steps, temperature, top_k, sampling
                 )
-                return toks, mutated["pagedkv"]
+                return toks, ok, mutated["pagedkv"]
 
             fn = self._decode_fns[sampling] = jax.jit(
                 step, donate_argnums=(1,)
@@ -178,10 +249,11 @@ class ServeEngine:
         fn = self._prefill_fns.get(key)
         if fn is None:
             model, page_size = self.model, self.page_size
+            poison_gate = self._chaos_poison
 
             def step(params, pages, tokens, positions, page_table,
                      slot_mapping, lengths, seeds, steps, temperature,
-                     top_k):
+                     top_k, poison=None):
                 meta = PagedMeta(
                     page_table=page_table, slot_mapping=slot_mapping,
                     lengths=lengths, page_size=page_size,
@@ -193,10 +265,16 @@ class ServeEngine:
                 )
                 # first token comes from the LAST VALID prompt row
                 last = logits[0, lengths[0] - 1][None]
+                if poison_gate:  # chaos injection, gated at trace time
+                    last = jnp.where(
+                        poison[:, None], jnp.asarray(jnp.nan, last.dtype),
+                        last,
+                    )
+                ok = finite_rows(last)
                 toks = self._pick_tokens(
                     last, seeds, steps, temperature, top_k, sampling
                 )
-                return toks, mutated["pagedkv"]
+                return toks, ok, mutated["pagedkv"]
 
             fn = self._prefill_fns[key] = jax.jit(
                 step, donate_argnums=(1,)
@@ -245,22 +323,58 @@ class ServeEngine:
         arts = {}
         buckets = self.prefill_buckets() if buckets is None else buckets
         for b in buckets:
+            extra = ((s(1, dtype=jnp.bool_),) if self._chaos_poison
+                     else ())
             traced = self._prefill_fn(b, sampling).trace(
                 params, pages, s(1, b), s(1, b), s(1, W), s(b), s(1),
-                s(1), s(1), s(1, dtype=jnp.float32), s(1),
+                s(1), s(1), s(1, dtype=jnp.float32), s(1), *extra,
             )
             arts[f"prefill-b{b}"] = {
                 "jaxpr": traced.jaxpr, "lowered": traced.lower(),
             }
         B = self.max_batch
+        extra = ((s(B, dtype=jnp.bool_),) if self._chaos_poison else ())
         traced = self._decode_step_fn(sampling).trace(
             params, pages, s(B, 1), s(B, 1), s(B, W), s(B), s(B), s(B),
-            s(B), s(B, dtype=jnp.float32), s(B),
+            s(B), s(B, dtype=jnp.float32), s(B), *extra,
         )
         arts["decode"] = {"jaxpr": traced.jaxpr, "lowered": traced.lower()}
         return arts
 
     # -- host-side step assembly ---------------------------------------
+
+    def _armed(self, phase):
+        """Watchdog guard for a blocking dispatch (no-op when no
+        ``step_timeout`` was configured)."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.armed(phase)
+
+    def _watchdog_context(self):
+        """Queue-depth snapshot for the watchdog's timeout dump: a hung
+        serve step should die naming what was in flight."""
+        sched = self.scheduler
+        return (
+            f"waiting={len(sched.waiting)} running={len(sched.running)} "
+            f"prefills={self.stats['prefills']} "
+            f"decode_steps={self.stats['decode_steps']} "
+            f"pool_free_pages={self.pool.num_free_pages}"
+        )
+
+    def _poison_row(self, seq):
+        return seq.req.request_id in self._poison_ids
+
+    def _quarantine(self, seq, phase):
+        """Retire one poisoned-row sequence: reason ``"failed"``, pages
+        freed, batch untouched."""
+        logger.warning(
+            "quarantined request %r after a nonfinite logits row in %s "
+            "(%d tokens emitted so far); the rest of the batch continues",
+            seq.req.request_id, phase, len(seq.generated),
+        )
+        self.scheduler.finish(seq, "failed")
+        self.stats["quarantined"] += 1
+        metrics.log_scalar("serve/quarantined", self.stats["quarantined"])
 
     def _padded_table(self, seq):
         table = np.zeros((self.table_width,), np.int32)
@@ -280,8 +394,7 @@ class ServeEngine:
         for r in range(n):
             slot_mapping[r] = self.pool.slot(seq.sid, r)
         req = seq.req
-        tok, self.pages = self._prefill_fn(
-            bucket, self._sampling_mode([seq]))(
+        args = [
             self.params, self.pages,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self._padded_table(seq)[None]),
@@ -291,9 +404,19 @@ class ServeEngine:
             jnp.asarray([len(seq.generated)], jnp.int32),
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
-        )
+        ]
+        if self._chaos_poison:
+            args.append(jnp.asarray([self._poison_row(seq)]))
+        with self._armed(f"serve/prefill-b{bucket}"):
+            tok, ok, self.pages = self._prefill_fn(
+                bucket, self._sampling_mode([seq]))(*args)
+            ok = np.asarray(ok)  # host sync: termination needs it
+            tok = np.asarray(tok)
         self.stats["prefills"] += 1
-        self._emit(seq, int(np.asarray(tok)[0]))
+        if not bool(ok[0]):
+            self._quarantine(seq, f"prefill-b{bucket}")
+            return
+        self._emit(seq, int(tok[0]))
 
     def _decode(self, seqs):
         B = self.max_batch
@@ -318,28 +441,44 @@ class ServeEngine:
             seeds[b] = seq.req.seed
             steps[b] = len(seq.generated)
         sampling = self._sampling_mode(seqs)
-        t0 = time.perf_counter()
-        toks, self.pages = self._decode_step_fn(sampling)(
+        args = [
             self.params, self.pages,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(slot_mapping),
             jnp.asarray(lengths), jnp.asarray(seeds),
             jnp.asarray(steps), jnp.asarray(temperature),
             jnp.asarray(top_k),
-        )
-        toks = np.asarray(toks)  # host sync: the scheduler needs them
-        self.stats["decode_time_s"] += time.perf_counter() - t0
+        ]
+        if self._chaos_poison:
+            poison = np.zeros((B,), bool)
+            for b, seq in enumerate(seqs):
+                poison[b] = self._poison_row(seq)
+            args.append(jnp.asarray(poison))
+        t0 = time.perf_counter()
+        with self._armed("serve/decode"):
+            toks, ok, self.pages = self._decode_step_fn(sampling)(*args)
+            toks = np.asarray(toks)  # host sync: the scheduler needs them
+            ok = np.asarray(ok)
+        dt = time.perf_counter() - t0
+        self.stats["decode_time_s"] += dt
+        self.decode_ms.append(dt * 1e3)
         self.stats["decode_steps"] += 1
         self.stats["decode_tokens"] += len(seqs)
+        if self.progress_path:
+            with open(self.progress_path, "a") as fh:
+                fh.write(f"{self.stats['decode_steps']}\n")
         for b, seq in enumerate(seqs):
-            self._emit(seq, int(toks[b]))
+            if not bool(ok[b]):
+                self._quarantine(seq, "decode")
+            else:
+                self._emit(seq, int(toks[b]))
 
     def _emit(self, seq, token):
         """Append one sampled token and settle termination."""
         seq.generated.append(token)
         self.stats["generated_tokens"] += 1
         if seq.first_token_at is None:
-            seq.first_token_at = time.perf_counter()
+            seq.first_token_at = self._clock()  # same clock as enqueued_at
             metrics.log_scalar(
                 "serve/ttft_ms",
                 (seq.first_token_at - seq.enqueued_at) * 1e3,
@@ -381,11 +520,21 @@ class ServeEngine:
                     f"seed {req.seed} out of the int32 sampling-key "
                     "range [0, 2**31)"
                 )
+            if req.deadline_ms is not None and req.deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0, got {req.deadline_ms!r}"
+                )
         seqs = []
         for req in requests:
-            seq = sched.add(req)
-            seq.enqueued_at = time.perf_counter()
+            seq = sched.add(req)  # may shed immediately (bounded queue)
+            seq.enqueued_at = self._clock()
+            self.stats["peak_waiting"] = max(
+                self.stats["peak_waiting"], len(sched.waiting)
+            )
             seqs.append(seq)
+        if sched.num_shed:
+            self._sync_lifecycle_stats()
+            metrics.log_scalar("serve/shed", sched.num_shed)
         t0 = time.perf_counter()
         try:
             self._run_to_completion(sched)
@@ -422,28 +571,144 @@ class ServeEngine:
                 prompt=list(seq.req.prompt),
                 tokens=list(seq.generated),
                 finish_reason=seq.finish_reason,
-                ttft_ms=(seq.first_token_at - seq.enqueued_at) * 1e3,
+                ttft_ms=(
+                    None if seq.first_token_at is None
+                    else (seq.first_token_at - seq.enqueued_at) * 1e3
+                ),
                 evictions=seq.evictions,
             ))
         return out
 
+    # -- lifecycle plumbing --------------------------------------------
+
+    def request_drain(self):
+        """Programmatic drain trigger — same semantics as SIGTERM
+        through a wired :class:`GracefulShutdown`: admission closes at
+        the next step boundary, running work gets ``drain_timeout``
+        seconds, and the engine stays drained (a drained engine sheds
+        everything a later ``generate()`` enqueues)."""
+        self._drain_flag = True
+
+    def _drain_requested(self):
+        return self._drain_flag or bool(
+            self.shutdown is not None and self.shutdown.requested
+        )
+
+    def _sync_lifecycle_stats(self):
+        self.stats["shed"] = self.scheduler.num_shed
+        self.stats["expired"] = self.scheduler.num_expired
+
+    def _fail_capacity(self, seq):
+        """Satellite fix: a request whose prefix can never fit even an
+        EMPTY pool must terminate — retrying admission (or the
+        preempt-retry recovery) forever cannot make room that does not
+        exist.  Reason ``"capacity"``, counted in metrics."""
+        logger.warning(
+            "request %r needs %d pages for its %d-token prefix; the "
+            "pool holds %d — failing fast with reason 'capacity'",
+            seq.req.request_id,
+            self.pool.pages_for(len(seq.prefix())), len(seq.prefix()),
+            self.pool.num_usable_pages,
+        )
+        self.scheduler.finish(seq, "capacity")
+        self.stats["capacity_failfast"] += 1
+        metrics.log_scalar(
+            "serve/capacity_failfast", self.stats["capacity_failfast"]
+        )
+
+    def _host_fault(self, seqs, phase, exc):
+        """A host-side step fault (sampler bug, bad batch assembly)
+        fails the IN-FLIGHT sequences, not the engine: they finish
+        ``"failed"``, their pages free, and the loop continues with the
+        rest.  Only when the fault consumed the donated pool buffers
+        (the jit died after invalidating its donation) is the engine
+        unservable — that re-raises."""
+        if any(getattr(leaf, "is_deleted", lambda: False)()
+               for leaf in jax.tree_util.tree_leaves(self.pages)):
+            logger.error(
+                "%s fault consumed the donated pool buffers — the "
+                "engine cannot continue", phase,
+            )
+            raise exc
+        failed = [s for s in seqs
+                  if not s.done and s in self.scheduler.running]
+        logger.error(
+            "host-side %s fault failed %d in-flight request(s): %r",
+            phase, len(failed), exc,
+        )
+        for seq in failed:
+            self.scheduler.finish(seq, "failed")
+        self.stats["host_faults"] += 1
+        metrics.log_scalar("serve/host_faults", self.stats["host_faults"])
+
     def _run_to_completion(self, sched):
         stalled = 0
+        draining = False
         while sched.has_work():
+            now = self._clock()
+            # deadline expiry at the ADMISSION boundary: a blown
+            # request must not take (or keep) pool pages
+            expired = bool(sched.expire(now))
+            if not draining and self._drain_requested():
+                draining = True
+                self._drain_started = now
+                # report what the DRAIN cut, not lifetime counters —
+                # pre-drain overload sheds are not the drain's doing
+                drain_shed0 = sched.num_shed
+                drain_expired0 = sched.num_expired
+                logger.warning(
+                    "drain requested: admission closed; shedding %d "
+                    "waiting request(s), %d running get %.1fs to finish",
+                    len(sched.waiting), len(sched.running),
+                    self.drain_timeout,
+                )
+            shed_now = 0
+            if draining:
+                # admission is closed: what waits now can never run
+                for seq in list(sched.waiting):
+                    sched.finish(seq, "shed")
+                    shed_now += 1
+                if (now - self._drain_started) > self.drain_timeout:
+                    for seq in list(sched.running):
+                        sched.finish(seq, "shed")
+                        shed_now += 1
+            self._sync_lifecycle_stats()
+            if not sched.has_work():
+                break
+            failed_fast = 0
+            admitted, did_decode = [], False
             try:
-                # admit() hands back fresh AND resumed sequences — a
-                # resumed one re-prefills prompt+generated, recreating
-                # exactly the KV state its eviction dropped
-                admitted = sched.admit(bucket=self.bucket_fn)
+                # capacity fail-fast BEFORE admission: a head request
+                # that can never fit would otherwise stall the queue
+                while (sched.waiting
+                       and self.pool.pages_for(
+                           len(sched.waiting[0].prefix()))
+                       > self.pool.num_usable_pages):
+                    self._fail_capacity(sched.waiting[0])
+                    failed_fast += 1
+                if not draining:
+                    # admit() hands back fresh AND resumed sequences —
+                    # a resumed one re-prefills prompt+generated,
+                    # recreating exactly the KV its eviction dropped
+                    admitted = sched.admit(bucket=self.bucket_fn)
                 for seq in admitted:
-                    self._prefill(seq)
-                sched.chaos_preempt()
-                did_decode = False
+                    try:
+                        self._prefill(seq)
+                    except Exception as exc:  # host fault isolation
+                        self._host_fault([seq], "prefill", exc)
+                if not draining:
+                    sched.chaos_preempt()
                 if sched.running:
                     todo = sched.prepare_decode()
                     if todo:
-                        self._decode(todo)
+                        try:
+                            self._decode(todo)
+                        except Exception as exc:  # host fault isolation
+                            self._host_fault(todo, "decode", exc)
                         did_decode = True
+                # deadline expiry at the DECODE boundary: pages free
+                # the moment the deadline blows, not a decode tail later
+                expired = bool(sched.expire(self._clock())) or expired
             except PoolExhausted:
                 # a pathological admission race got past the
                 # can_alloc/extend guards (e.g. page accounting the
@@ -453,7 +718,13 @@ class ServeEngine:
                 # nothing is lost and its re-prefill recreates the
                 # dropped KV — and retry the step on the freed pages.
                 if not sched.running:
-                    raise  # nothing to evict: the pool is truly too small
+                    if sched.waiting and self.pool.is_idle():
+                        # even an EMPTY pool cannot hold the head
+                        # request: capacity, not a recoverable race
+                        self._fail_capacity(sched.waiting[0])
+                        stalled = 0
+                        continue
+                    raise  # pages missing with nothing running: a bug
                 sched.preempt(sched._pick_victim())
                 self.stats["pool_exhausted_recoveries"] += 1
                 metrics.log_scalar(
@@ -465,6 +736,9 @@ class ServeEngine:
             self.stats["peak_pool_occupancy"] = max(
                 self.stats["peak_pool_occupancy"], self.pool.occupancy()
             )
+            self.stats["peak_waiting"] = max(
+                self.stats["peak_waiting"], len(sched.waiting)
+            )
             metrics.log_scalar(
                 "serve/pool_occupancy", self.pool.occupancy()
             )
@@ -473,10 +747,33 @@ class ServeEngine:
             # that drained the batch): the freed pages guarantee the
             # NEXT iteration admits.  Two empty iterations in a row
             # cannot happen unless the scheduler is genuinely wedged.
-            stalled = 0 if (admitted or did_decode) else stalled + 1
+            progressed = bool(admitted or did_decode or expired
+                              or failed_fast or shed_now)
+            stalled = 0 if progressed else stalled + 1
             if stalled >= 2 and sched.has_work():
                 raise RuntimeError(
                     "scheduler stalled with work queued — this is a bug "
                     "(the admission guard should make progress "
                     "inevitable)"
                 )
+        self._sync_lifecycle_stats()
+        if draining:
+            drain_ms = (self._clock() - self._drain_started) * 1e3
+            signame = None
+            if (self.shutdown is not None
+                    and self.shutdown.signum is not None):
+                import signal
+
+                signame = signal.Signals(self.shutdown.signum).name
+            self.drain_report = {
+                "requested": True,
+                "signal": signame,
+                "drain_ms": round(drain_ms, 2),
+                "drain_timeout_s": self.drain_timeout,
+                "shed": self.scheduler.num_shed - drain_shed0,
+                "expired": self.scheduler.num_expired - drain_expired0,
+                "deadline_exceeded": drain_ms > self.drain_timeout * 1e3,
+                "pool_idle": self.pool.is_idle(),
+            }
+            metrics.log_scalar("serve/drain_ms", drain_ms)
+            logger.warning("drain complete: %s", self.drain_report)

@@ -69,6 +69,14 @@ class PagedKVPool:
     def can_alloc(self, num_tokens):
         return self.pages_for(num_tokens) <= len(self._free)
 
+    def is_idle(self):
+        """True iff no sequence holds pages and every usable page is
+        back on the free list — what a drained engine's pool must look
+        like (the drain report and chaos harness assert it alongside
+        :meth:`check_invariants`)."""
+        return (not self._tables
+                and len(self._free) == self.num_usable_pages)
+
     # -- alloc / extend / free -----------------------------------------
 
     def alloc(self, seq_id, num_tokens):

@@ -39,6 +39,18 @@ def step_keys(seeds, steps):
     )(seeds, steps)
 
 
+def finite_rows(logits):
+    """Per-row health of the logits a token is sampled from: bool [B],
+    False where ANY entry of the row is NaN/Inf.  The serve engine
+    folds this into its jitted prefill/decode steps (the anomaly-guard
+    pattern from ``resilience/anomaly.py``, applied per request): a
+    poisoned row is quarantined on the host — it finishes ``"failed"``
+    and its pages are freed — while the rest of the batch continues
+    token-identically, because decode rows only ever attend over their
+    own pages."""
+    return jnp.isfinite(logits.astype(jnp.float32)).all(axis=-1)
+
+
 def _top_k_mask(logits, top_k):
     """Mask logits below each row's k-th largest value.  ``top_k`` is a
     per-row int array; 0 (or >= vocab) disables the filter for that row.

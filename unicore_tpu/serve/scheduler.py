@@ -23,7 +23,33 @@ decode steps under a token budget):
   intact; on re-admission it re-prefills prompt + generated and
   continues — with seeded sampling keyed by absolute step index, the
   continuation is token-identical to an uninterrupted run.
-- Termination: EOS, ``max_new_tokens``, or context capacity.
+- Termination: EOS (``"eos"``), ``max_new_tokens`` (``"length"``),
+  context capacity (``"capacity"``), a blown deadline (``"expired"``),
+  overload shedding (``"shed"``), or a per-request fault (``"failed"``,
+  the engine's quarantine path).
+
+Robustness policy (ISSUE 7):
+
+- **Deadlines.**  A request may carry ``deadline_ms`` (TTL from
+  enqueue); :meth:`expire` retires blown requests at admission and at
+  every decode boundary, freeing their pages immediately — a request
+  nobody is waiting for anymore must not hold pool capacity.
+- **Overload shedding.**  ``max_waiting`` bounds the waiting queue,
+  with free decode slots counted as headroom (an idle engine admits
+  ``max_batch + max_waiting`` before shedding; a saturated one holds
+  the line at exactly ``max_waiting``); past the bound :meth:`add`
+  SHEDS deterministically instead of growing without bound
+  (reject-newest by default; ``shed_policy`` is the hook for
+  priority-aware policies later).  A shed request finishes immediately
+  with reason ``"shed"`` — backpressure the caller can see beats an
+  invisible queue that blows every deadline behind it.
+- **Starvation protection.**  LIFO preemption alone can evict the same
+  long prompt forever (every re-prefill makes it the newest again).
+  Each sequence carries a re-prefill budget (``request_retries``):
+  once its evictions reach the budget it is PROMOTED — the organic
+  victim scan and chaos preemption both skip it — so an admitted
+  request's eviction count is bounded and it eventually finishes.
+  Requeue-at-front preserves age priority on the admission side.
 
 ``chaos_rate`` injects random preemptions (seeded) — the scheduler
 property tests force evictions through it instead of hoping a trace
@@ -36,11 +62,14 @@ from typing import List, Optional
 
 from .kv_pool import PoolExhausted
 
+DEFAULT_REQUEST_RETRIES = 8
+
 
 @dataclasses.dataclass
 class Request:
     """One generation request (all sampling state is explicit so a
-    result is reproducible from the request alone)."""
+    result is reproducible from the request alone).  ``deadline_ms`` is
+    a TTL measured from enqueue; ``None`` means no deadline."""
 
     prompt: List[int]
     max_new_tokens: int
@@ -49,6 +78,7 @@ class Request:
     seed: int = 0
     eos_id: Optional[int] = None
     request_id: Optional[str] = None
+    deadline_ms: Optional[float] = None
 
 
 class Sequence:
@@ -72,19 +102,41 @@ class Sequence:
     def done(self):
         return self.finish_reason is not None
 
+    def deadline_blown(self, now):
+        """True when the request's TTL has elapsed at host time ``now``
+        (same clock that stamped ``enqueued_at``)."""
+        return (self.req.deadline_ms is not None
+                and self.enqueued_at is not None
+                and (now - self.enqueued_at) * 1e3 > self.req.deadline_ms)
+
+
+def reject_newest(scheduler, incoming):
+    """Default shed policy: the incoming request is the victim.  Purely
+    deterministic — same arrival order, same shed decisions — which is
+    what the overload chaos leg asserts run to run."""
+    del scheduler
+    return incoming
+
 
 class Scheduler:
     def __init__(self, pool, max_batch, prefill_token_budget=512,
-                 chaos_rate=0.0, chaos_rng=None):
+                 chaos_rate=0.0, chaos_rng=None, max_waiting=None,
+                 request_retries=DEFAULT_REQUEST_RETRIES,
+                 shed_policy=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.prefill_token_budget = int(prefill_token_budget)
         self.chaos_rate = float(chaos_rate)
         self.chaos_rng = chaos_rng
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
+        self.request_retries = int(request_retries)
+        self.shed_policy = shed_policy or reject_newest
         self.waiting = deque()
         self.running: List[Sequence] = []
         self.finished: List[Sequence] = []
         self.num_evictions = 0
+        self.num_shed = 0
+        self.num_expired = 0
         self._next_sid = 0
 
     # -- queue management ---------------------------------------------
@@ -109,10 +161,45 @@ class Scheduler:
                 "max_new_tokens must be >= 1 (prefill always samples "
                 "the first token)"
             )
+        if req.deadline_ms is not None and req.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {req.deadline_ms!r} "
+                "(use None for no deadline)"
+            )
         seq = Sequence(self._next_sid, req)
         self._next_sid += 1
+        # free decode slots count as headroom: a bound that shed while
+        # the batch sat idle would throttle capacity, not overload.
+        # Saturated (running == max_batch) the bound is exactly
+        # max_waiting; the transient above it is the portion the next
+        # admission boundary immediately drains into the batch.
+        if (self.max_waiting is not None
+                and len(self.waiting) >= self.max_waiting
+                + max(0, self.max_batch - len(self.running))):
+            victim = self.shed_policy(self, seq)
+            if victim is not seq:
+                # a policy chose a queued victim over the newcomer:
+                # shed it and take the newcomer in its place
+                self.finish(victim, "shed")
+                self.waiting.append(seq)
+            else:
+                self.finish(seq, "shed")
+            return seq
         self.waiting.append(seq)
         return seq
+
+    def expire(self, now):
+        """Retire every waiting/running sequence whose deadline has
+        blown at host time ``now``, freeing running sequences' pages
+        immediately.  Returns the expired sequences.  The engine calls
+        this at admission and at every decode boundary — expiry must
+        never wait behind a long decode tail."""
+        expired = []
+        for seq in list(self.running) + list(self.waiting):
+            if seq.deadline_blown(now):
+                self.finish(seq, "expired")
+                expired.append(seq)
+        return expired
 
     def has_work(self):
         return bool(self.waiting or self.running)
@@ -157,12 +244,17 @@ class Scheduler:
         return admitted
 
     def chaos_preempt(self):
-        """Randomly preempt one running sequence (seeded test hook)."""
+        """Randomly preempt one running sequence (seeded test hook).
+        Promoted sequences (re-prefill budget exhausted) are exempt —
+        the starvation bound must hold under chaos too."""
         if (self.chaos_rng is not None and self.chaos_rate > 0.0
                 and self.running
                 and self.chaos_rng.random() < self.chaos_rate):
-            victim = self.running[self.chaos_rng.randrange(
-                len(self.running))]
+            victims = [s for s in self.running
+                       if s.evictions < self.request_retries]
+            if not victims:
+                return None
+            victim = victims[self.chaos_rng.randrange(len(victims))]
             self.preempt(victim)
             return victim
         return None
@@ -186,7 +278,17 @@ class Scheduler:
         return list(self.running)
 
     def _pick_victim(self):
-        # LIFO: the most recently admitted loses the least sunk work
+        """LIFO among sequences still under their re-prefill budget:
+        the most recently admitted loses the least sunk work.  A
+        sequence that already paid ``request_retries`` re-prefills is
+        promoted past the scan — without this, a long prompt is evicted
+        the moment it re-admits (its re-prefill makes it the newest
+        again) and starves forever.  If EVERY running sequence is
+        promoted the newest one is evicted anyway: liveness beats the
+        budget, and requeue-at-front still bounds how long it waits."""
+        for seq in reversed(self.running):
+            if seq.evictions < self.request_retries:
+                return seq
         return self.running[-1]
 
     def preempt(self, seq):
@@ -201,7 +303,17 @@ class Scheduler:
         self.num_evictions += 1
 
     def finish(self, seq, reason):
-        self.pool.free(seq.sid)
-        self.running.remove(seq)
+        """Terminal transition from EITHER queue (or neither — an
+        add-time shed was never enqueued): a running sequence's pages
+        are freed; waiting sequences hold none."""
+        if seq in self.running:
+            self.pool.free(seq.sid)
+            self.running.remove(seq)
+        elif seq in self.waiting:
+            self.waiting.remove(seq)
         seq.finish_reason = reason
         self.finished.append(seq)
+        if reason == "shed":
+            self.num_shed += 1
+        elif reason == "expired":
+            self.num_expired += 1
