@@ -802,6 +802,76 @@ def test_unbounded_queue_growth_inline_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL110 unguarded-dataset-io
+# ---------------------------------------------------------------------
+
+def test_unguarded_dataset_io_fires(tmp_path):
+    # filename marks it a dataset file; raw IO in __getitem__ with no
+    # typed re-raise = 3 findings (open+loads, lmdb get), and a broad
+    # swallow in __iter__ = 1 more
+    found = _lint_snippet(tmp_path, "raw_dataset.py", """
+        import pickle
+        class Raw:
+            def __getitem__(self, idx):
+                with open(self.paths[idx], "rb") as f:
+                    return pickle.loads(f.read())
+        class Db:
+            def __getitem__(self, idx):
+                return self._env.begin().get(self._keys[idx])
+        class It:
+            def __iter__(self):
+                for p in self.paths:
+                    try:
+                        yield pickle.load(open(p, "rb"))
+                    except Exception:
+                        continue
+    """)
+    ul110 = [f for f in found if f.rule == "UL110"]
+    # Raw: open + pickle.loads; Db: lmdb get; It: the swallow (the IO
+    # inside the try is separately unguarded too — no re-raise)
+    assert len(ul110) >= 4, found
+
+
+def test_unguarded_dataset_io_silent_on_typed_reraise(tmp_path):
+    found = _lint_snippet(tmp_path, "rec_dataset.py", """
+        import pickle
+        from unicore_tpu.data.resilient import DataIntegrityError
+        class Store:
+            def __getitem__(self, idx):
+                try:
+                    return pickle.loads(self._bytes(idx))
+                except pickle.UnpicklingError as e:
+                    raise DataIntegrityError(f"record {idx} torn") from e
+            def helper_outside_fetch(self, p):
+                return pickle.load(open(p, "rb"))  # not a fetch body
+        class NoIo:
+            def __getitem__(self, idx):
+                return self.items[idx]
+    """)
+    assert "UL110" not in rules_of(found)
+
+
+def test_unguarded_dataset_io_ignores_non_dataset_files(tmp_path):
+    found = _lint_snippet(tmp_path, "container.py", """
+        import pickle
+        class Box:
+            def __getitem__(self, idx):
+                return pickle.loads(self.blobs[idx])
+    """)
+    assert "UL110" not in rules_of(found)
+
+
+def test_unguarded_dataset_io_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "raw_dataset.py", """
+        import pickle
+        class Raw:
+            def __getitem__(self, idx):
+                return pickle.loads(self.blobs[idx])  # unicore-lint: disable=UL110
+    """)
+    assert "UL110" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # Pass 3: HLO parsing primitives (pure text, no compile)
 # ---------------------------------------------------------------------
 

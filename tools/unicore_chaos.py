@@ -49,9 +49,28 @@ applied to the continuous-batching engine):
                              bit-identical to the solo oracle (no
                              starvation under chaos preemption).
 
+Input-pipeline legs (``--data``, ISSUE 9 — the fault ladder extended
+into the data layer, docs/fault_tolerance.md "Input pipeline"):
+
+  --data corrupt:K   tear K seeded records of train.rec; the guarded run
+                     must survive with exactly K deterministic epoch-1
+                     skips, its skip log (riding the checkpoint) must
+                     match a host-side seeded oracle replaying
+                     resilient.resample_index, and a SIGKILL landing
+                     AFTER a skipped batch must resume bit-exact;
+  --data truncate    cut the tail off train.rec; the run must die with a
+                     typed DataIntegrityError at FIRST touch (no
+                     silently-truncated tensors) — guard off, because
+                     this is the default contract;
+  --data hang        wedge the 25th dataset fetch inside a worker; the
+                     step watchdog must fire on the stalled batch wait
+                     and exit 87 with a dump naming the worker impl and
+                     the stuck dataset indices.
+
 CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
 (SIGKILL at a random step + one torn shard + bit-exact resume), the
-``--inject nonfinite:4`` leg, and the serve poison + graceful legs.
+``--inject nonfinite:4`` leg, the serve poison + graceful + flood legs,
+and the ``--data corrupt:2`` + ``--data hang`` legs.
 Exit code 0 iff every assertion holds.
 """
 
@@ -92,7 +111,7 @@ def build_corpus(data_dir, seed=0):
     return data_dir
 
 
-def train_cmd(args, data_dir, save_dir, traj_file):
+def train_cmd(args, data_dir, save_dir, traj_file, extra=None):
     cmd = [
         sys.executable, "-m", "unicore_tpu_cli.train", data_dir,
         "--user-dir", os.path.join(REPO, "examples", "bert"),
@@ -120,6 +139,8 @@ def train_cmd(args, data_dir, save_dir, traj_file):
     ]
     if args.fsdp_size > 1:
         cmd += ["--fsdp-size", str(args.fsdp_size)]
+    if extra:
+        cmd += list(extra)  # argparse: the LAST occurrence of a flag wins
     return cmd
 
 
@@ -567,6 +588,283 @@ def serve_main(args):
 
 
 # ----------------------------------------------------------------------
+# input-pipeline chaos (ISSUE 9): --data corrupt:K | truncate | hang
+# ----------------------------------------------------------------------
+
+# one flag set for every data leg: the guard ON (the opt-in skip ladder
+# under test), a budget roomy enough that K seeded corruptions skip
+# instead of aborting, and REAL forked worker processes so the
+# skip-relay/commit path is exercised end to end.  Process impl, not
+# thread: per-item masking draws through the numpy_seed GLOBAL-state
+# idiom, which is only deterministic when each worker owns its own
+# process-global RNG — concurrent threads race the save/seed/restore.
+DATA_GUARD_FLAGS = [
+    "--data-guard", "--data-corrupt-budget", "0.2",
+    "--num-workers", "2", "--worker-impl", "process",
+]
+
+
+def corrupt_train_records(data_dir, k, seed):
+    """Overwrite K seeded record spans of train.rec with 0xFF bytes (an
+    invalid pickle opcode stream, so decode fails deterministically —
+    the real-world analogue is a torn page).  Returns the indices."""
+    import numpy as np
+
+    rec = os.path.join(data_dir, "train.rec")
+    offsets = np.fromfile(rec + ".idx", dtype=np.int64)
+    rng = random.Random(seed ^ 0x5EED)
+    picks = sorted(rng.sample(range(len(offsets) - 1), k))
+    with open(rec, "r+b") as f:
+        for i in picks:
+            f.seek(int(offsets[i]))
+            f.write(b"\xff" * int(offsets[i + 1] - offsets[i]))
+    return picks
+
+
+def read_skip_log(save_dir):
+    """The run's committed skip decisions, straight from the checkpoint
+    it rode through (``extra_state/train_iterator/data_guard``)."""
+    from unicore_tpu.checkpoint_utils import load_checkpoint_to_cpu
+
+    state = load_checkpoint_to_cpu(
+        os.path.join(save_dir, "checkpoint_last.pt")
+    )
+    itr = state.get("extra_state", {}).get("train_iterator", {})
+    guard = itr.get("data_guard", {})
+    entries = sorted(
+        guard.get("entries", []),
+        key=lambda e: (e["epoch"], e["index"]),
+    )
+    return [{k: e[k] for k in ("epoch", "index", "replacement", "attempt")}
+            for e in entries]
+
+
+def predict_skips(entries, corrupt, seed, n):
+    """The seeded skip-ORACLE: for each (epoch, index) the run skipped,
+    replay resilient.resample_index host-side — attempts burn on draws
+    that land in the corrupt set — and return what the log MUST say."""
+    from unicore_tpu.data.resilient import resample_index
+
+    out = []
+    bad = set(corrupt)
+    for e in entries:
+        epoch, index = int(e["epoch"]), int(e["index"])
+        attempt, j = 0, None
+        while attempt < 64:
+            attempt += 1
+            j = resample_index(seed, epoch, index, attempt, n)
+            if j not in bad:
+                break
+        out.append({"epoch": epoch, "index": index, "replacement": j,
+                    "attempt": attempt})
+    return out
+
+
+def data_corrupt_leg(args, k, workdir, report):
+    """K corrupt records: the run survives with exactly K deterministic
+    epoch-1 skips, the skip log matches the seeded oracle, and a
+    SIGKILL landing after a skipped batch resumes bit-exact."""
+    from unicore_tpu.resilience import read_trajectory
+
+    # one epoch is 12 updates over the 96-record corpus; run into epoch
+    # 2 so corrupt records are re-touched after the resume as well
+    args.max_update = max(args.max_update, 14)
+    data_dir = build_corpus(os.path.join(workdir, "data"), seed=args.seed)
+    picks = corrupt_train_records(data_dir, k, args.seed)
+    print(f"[chaos] data corrupt leg: tore records {picks} of train.rec",
+          flush=True)
+    report["data"]["corrupt_indices"] = picks
+    env = run_env(args)
+
+    oracle_traj = os.path.join(workdir, "oracle.jsonl")
+    oracle_save = os.path.join(workdir, "oracle_ckpt")
+    run_to_completion(
+        train_cmd(args, data_dir, oracle_save, oracle_traj,
+                  extra=DATA_GUARD_FLAGS), env,
+    )
+    oracle = read_trajectory(oracle_traj)
+    assert oracle[-1]["update"] == args.max_update, oracle[-2:]
+    oracle_skips = read_skip_log(oracle_save)
+
+    # the seeded oracle: every skip's replacement must be the pure
+    # function of (seed, epoch, index) — and epoch 1, which reads every
+    # record, must have skipped EXACTLY the K torn ones
+    predicted = predict_skips(oracle_skips, picks, args.seed, n=96)
+    epoch1 = [e for e in oracle_skips if e["epoch"] == 1]
+    if sorted(e["index"] for e in epoch1) != picks:
+        raise RuntimeError(
+            f"epoch-1 skips {sorted(e['index'] for e in epoch1)} != the "
+            f"{k} torn records {picks}"
+        )
+    if oracle_skips != predicted:
+        raise RuntimeError(
+            f"skip log diverged from the seeded oracle:\n"
+            f"  run: {oracle_skips}\n  oracle: {predicted}"
+        )
+
+    # chaos: SIGKILL only after at least one skip was committed (so the
+    # resume provably crosses a skipped batch) and a checkpoint exists
+    chaos_traj = os.path.join(workdir, "chaos.jsonl")
+    chaos_save = os.path.join(workdir, "chaos_ckpt")
+    cmd = train_cmd(args, data_dir, chaos_save, chaos_traj,
+                    extra=DATA_GUARD_FLAGS)
+    victim_log = chaos_traj + ".victim.log"
+
+    def skip_seen():
+        if not os.path.exists(victim_log):
+            return False
+        with open(victim_log, errors="replace") as f:
+            return "data guard: resampled" in f.read()
+
+    floor = 2 * args.save_interval_updates + 1
+    print(f"[chaos] data corrupt leg: SIGKILL once a skip is logged and "
+          f"{floor} steps ran", flush=True)
+    run_and_kill(
+        cmd, env, chaos_traj, graceful=False,
+        trigger=lambda: skip_seen() and traj_lines(chaos_traj) >= floor,
+        desc="a committed skip + a checkpointed step",
+    )
+    out = run_to_completion(cmd, env)
+    if "Loaded checkpoint" not in out:
+        raise RuntimeError("resume did not load a checkpoint:\n"
+                           + out[-2000:])
+
+    chaos_records = read_trajectory(chaos_traj)
+    assert chaos_records[-1]["update"] == args.max_update, chaos_records[-2:]
+    mismatches, compared = compare_trajectories(oracle, chaos_records)
+    chaos_skips = read_skip_log(chaos_save)
+    report["bit_exact"] = not mismatches
+    report["records_compared"] = compared
+    report["mismatches"] = mismatches[:20]
+    report["data"].update({
+        "skips": oracle_skips,
+        "skips_epoch1": len(epoch1),
+        "skip_log_match": chaos_skips == oracle_skips == predicted,
+        "chaos_skips": chaos_skips,
+    })
+    if mismatches:
+        raise RuntimeError(
+            f"data corrupt leg: {len(mismatches)} trajectory mismatches "
+            f"vs the oracle: {mismatches[:3]}"
+        )
+    if chaos_skips != oracle_skips:
+        raise RuntimeError(
+            f"data corrupt leg: resumed run's skip log diverged:\n"
+            f"  chaos: {chaos_skips}\n  oracle: {oracle_skips}"
+        )
+    print(f"[chaos] data corrupt leg OK: {compared} records bit-exact, "
+          f"{len(oracle_skips)} skips oracle-matched", flush=True)
+
+
+def data_truncate_leg(args, workdir, report):
+    """A truncated train.rec must raise DataIntegrityError at FIRST
+    touch (dataset open), guard or no guard — never silently-truncated
+    tensors.  Runs WITHOUT --data-guard: this is the default
+    contract."""
+    data_dir = build_corpus(os.path.join(workdir, "data"), seed=args.seed)
+    rec = os.path.join(data_dir, "train.rec")
+    size = os.path.getsize(rec)
+    with open(rec, "r+b") as f:
+        f.truncate(size - max(64, size // 10))
+    print(f"[chaos] data truncate leg: cut train.rec {size} -> "
+          f"{os.path.getsize(rec)} bytes", flush=True)
+    env = run_env(args)
+    cmd = train_cmd(args, data_dir, os.path.join(workdir, "ckpt"),
+                    os.path.join(workdir, "traj.jsonl"))
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    report["data"].update({
+        "exit_code": proc.returncode,
+        "typed_error": "DataIntegrityError" in out,
+    })
+    if proc.returncode == 0:
+        raise RuntimeError(
+            "truncate leg: the run SUCCEEDED over a truncated data file "
+            "— silently-truncated tensors:\n" + out[-2000:]
+        )
+    if "DataIntegrityError" not in out:
+        raise RuntimeError(
+            f"truncate leg: run died rc={proc.returncode} but not via "
+            f"DataIntegrityError:\n" + out[-2000:]
+        )
+    print("[chaos] data truncate leg OK: typed error at first touch",
+          flush=True)
+
+
+def data_hang_leg(args, workdir, report):
+    """A wedged data worker: the step watchdog must fire on the stalled
+    batch wait, dump a context line naming the worker impl + the stuck
+    dataset indices, and exit 87 for the supervisor."""
+    data_dir = build_corpus(os.path.join(workdir, "data"), seed=args.seed)
+    env = run_env(args)
+    # the 25th fetch wedges (mid-epoch, after a couple of clean steps)
+    env["UNICORE_TPU_CHAOS_DATA_HANG"] = "25"
+    # thread impl here (last flag wins): the hang counter is shared
+    # across worker threads so fetch #25 is exact, and the leg's whole
+    # point is the dump NAMING the impl — no trajectory comparison, so
+    # the numpy_seed thread caveat does not apply
+    cmd = train_cmd(
+        args, data_dir, os.path.join(workdir, "ckpt"),
+        os.path.join(workdir, "traj.jsonl"),
+        extra=DATA_GUARD_FLAGS + ["--worker-impl", "thread",
+                                  "--step-timeout", "10"],
+    )
+    print("[chaos] data hang leg: fetch #25 wedges; watchdog armed at "
+          "10s", flush=True)
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    context_named = ("watchdog context" in out and "impl=thread" in out
+                     and "awaiting_indices=" in out)
+    report["data"].update({
+        "exit_code": proc.returncode,
+        "context_named": context_named,
+    })
+    if proc.returncode != 87:
+        raise RuntimeError(
+            f"hang leg: expected watchdog exit 87, got "
+            f"rc={proc.returncode}:\n" + out[-3000:]
+        )
+    if not context_named:
+        raise RuntimeError(
+            "hang leg: the timeout dump did not name the input pipeline "
+            "(impl + stuck indices):\n" + out[-3000:]
+        )
+    print("[chaos] data hang leg OK: exit 87 with a named pipeline dump",
+          flush=True)
+
+
+def data_main(args):
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="unicore_chaos_data_")
+    os.makedirs(workdir, exist_ok=True)
+    leg, _, arg = args.data.partition(":")
+    report = {"mode": "data", "leg": args.data, "workdir": workdir,
+              "seed": args.seed, "data": {}}
+    if leg == "corrupt":
+        data_corrupt_leg(args, int(arg or 2), workdir, report)
+    elif leg == "truncate":
+        data_truncate_leg(args, workdir, report)
+    elif leg == "hang":
+        data_hang_leg(args, workdir, report)
+    else:
+        raise SystemExit(
+            f"--data supports corrupt:K | truncate | hang, got "
+            f"{args.data!r}"
+        )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    if not args.keep and args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(f"[chaos] OK: data leg {args.data!r} held")
+    return 0
+
+
+# ----------------------------------------------------------------------
 # main
 # ----------------------------------------------------------------------
 
@@ -616,6 +914,14 @@ def build_parser():
                         "at the next step boundary (no swallowed IO), and "
                         "the resume must be bit-exact from the last intact "
                         "checkpoint")
+    p.add_argument("--data", default=None, metavar="LEG",
+                   help="input-pipeline chaos instead of kill/resume: "
+                        "'corrupt:K' (K torn records -> K deterministic "
+                        "skips, skip log vs a seeded oracle, SIGKILL+"
+                        "resume across a skipped batch bit-exact), "
+                        "'truncate' (torn train.rec -> DataIntegrityError "
+                        "at first touch, loud death), 'hang' (wedged "
+                        "worker -> watchdog exit 87 naming the pipeline)")
     p.add_argument("--serve", action="store_true",
                    help="serve-tier chaos instead of training: combine "
                         "with --inject poison:K (quarantine + survivor "
@@ -638,6 +944,8 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.serve:
         return serve_main(args)
+    if args.data:
+        return data_main(args)
     import tempfile
 
     from unicore_tpu.resilience import read_trajectory

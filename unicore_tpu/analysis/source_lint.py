@@ -49,6 +49,18 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   ``max_waiting`` + deterministic shedding exists precisely so
   backpressure is visible to callers instead.
 
+- UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
+  ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
+  ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
+  handler re-raises a typed error — or a broad ``except`` in such a
+  body that never re-raises.  A torn record surfacing as a raw
+  ``UnpicklingError`` (or worse, swallowed into a garbage sample)
+  bypasses the input-pipeline fault ladder: the guarded fetch layer
+  (``data/resilient.py``) keys its retry/skip/abort decisions on
+  ``DataIntegrityError``, so every dataset fetch path must translate
+  IO failures into it (the way ``indexed_dataset``/``lmdb_dataset``
+  do).
+
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
 """
@@ -123,6 +135,10 @@ _UL108_SYNC_TAILS = {"device_get", "block_until_ready"}
 # (CheckpointManager --async-save / AsyncCheckpointWriter) exists so
 # the step path only ever pays the device->host capture
 _UL108_SAVE_TAILS = {"save_checkpoint", "write_checkpoint", "atomic_save"}
+
+# UL110: call tails that read raw record bytes inside a dataset fetch
+# (open is matched separately; lmdb gets via the begin()/txn heuristic)
+_UL110_IO_TAILS = {"loads", "load", "fromfile", "memmap", "frombuffer"}
 
 # UL109: a loop is a SERVE LOOP iff its body drives request scheduling
 _SERVE_LOOP_MARKERS = {"admit", "prepare_decode", "serve_step",
@@ -731,6 +747,86 @@ class _ModuleLint(ast.NodeVisitor):
                     )
         self.generic_visit(node)
 
+    # -- UL110 ---------------------------------------------------------
+
+    def _ul110_io_kind(self, call):
+        """Classify a call inside a dataset fetch body as raw record IO:
+        ``open``, pickle/numpy byte loads, or an LMDB-style ``.get``
+        (receiver goes through ``begin()`` or names a txn/env)."""
+        chain = _attr_chain(call.func)
+        if chain is not None:
+            parts = chain.split(".")
+            if parts[0] == "open" or parts[-1] == "open":
+                return "open()"
+            if len(parts) > 1 and parts[-1] in _UL110_IO_TAILS:
+                return f"'{chain}'"
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "get":
+            for sub in ast.walk(call.func.value):
+                name = None
+                if isinstance(sub, ast.Attribute):
+                    name = sub.attr
+                elif isinstance(sub, ast.Name):
+                    name = sub.id
+                if name and ("begin" == name or "txn" in name
+                             or "env" in name.lstrip("_")):
+                    return "an LMDB get"
+        return None
+
+    @staticmethod
+    def _handler_reraises(handler):
+        return any(isinstance(s, ast.Raise) for s in ast.walk(handler))
+
+    def _check_dataset_fetch_guard(self, fn):
+        """UL110 over one ``__getitem__``/``__iter__`` body: every raw IO
+        call must sit under a ``try`` whose handler re-raises (the typed
+        ``DataIntegrityError`` translation), and no broad handler may
+        swallow without re-raising.  Nested function defs are fresh
+        scopes, as everywhere in this linter."""
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Try):
+                    covers = guarded or any(
+                        self._handler_reraises(h) for h in child.handlers
+                    )
+                    for stmt in child.body:
+                        walk(stmt, covers)
+                    for h in child.handlers:
+                        broad, _ = self._handler_is_broad(h)
+                        if broad and not self._handler_reraises(h):
+                            self.emit(
+                                "UL110", "unguarded-dataset-io", "error", h,
+                                f"broad except in dataset '{fn.name}' "
+                                f"swallows the failure without a typed "
+                                f"re-raise — a torn record becomes a "
+                                f"silent garbage sample the guarded "
+                                f"fetch layer can never see; re-raise "
+                                f"DataIntegrityError",
+                            )
+                        for stmt in h.body:
+                            walk(stmt, guarded)
+                    for stmt in child.orelse + child.finalbody:
+                        walk(stmt, guarded)
+                    continue
+                if isinstance(child, ast.Call) and not guarded:
+                    kind = self._ul110_io_kind(child)
+                    if kind:
+                        self.emit(
+                            "UL110", "unguarded-dataset-io", "error", child,
+                            f"{kind} in dataset '{fn.name}' with no "
+                            f"typed re-raise around it — a torn record "
+                            f"surfaces as a raw decode error (or silent "
+                            f"truncation) instead of the "
+                            f"DataIntegrityError the input-pipeline "
+                            f"fault ladder keys on "
+                            f"(data/resilient.py)",
+                        )
+                walk(child, guarded)
+
+        walk(fn, False)
+
     # -- traversal -----------------------------------------------------
 
     def visit_With(self, node):
@@ -758,6 +854,9 @@ class _ModuleLint(ast.NodeVisitor):
                 if self._fn_is_jitted(node):
                     self._check_numpy_in_jit(node)
                 self._check_jit_decorators(node)
+                if (self.dataset_file
+                        and node.name in ("__getitem__", "__iter__")):
+                    self._check_dataset_fetch_guard(node)
 
     def run(self):
         self.visit(self._tree)

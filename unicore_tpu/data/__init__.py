@@ -7,6 +7,13 @@ from .unicore_dataset import UnicoreDataset, EpochListening  # noqa isort:skip
 from .base_wrapper_dataset import BaseWrapperDataset  # noqa isort:skip
 
 from . import data_utils, iterators  # noqa
+from .resilient import (  # noqa isort:skip
+    DataGuardConfig,
+    DataIntegrityError,
+    GuardedDataset,
+    SkipLog,
+    resample_index,
+)
 from .bert_tokenize_dataset import BertTokenizeDataset  # noqa
 from .dictionary import Dictionary  # noqa
 from .indexed_dataset import (  # noqa
@@ -40,7 +47,10 @@ __all__ = [
     "AppendTokenDataset",
     "BaseWrapperDataset",
     "BertTokenizeDataset",
+    "DataGuardConfig",
+    "DataIntegrityError",
     "Dictionary",
+    "GuardedDataset",
     "EpochListening",
     "EpochShuffleDataset",
     "FromNumpyDataset",
@@ -60,7 +70,9 @@ __all__ = [
     "RawNumpyDataset",
     "RightPadDataset",
     "RightPadDataset2D",
+    "SkipLog",
     "SortDataset",
+    "resample_index",
     "TokenizeDataset",
     "TruncateDataset",
     "UnicoreDataset",

@@ -15,6 +15,7 @@ from functools import lru_cache
 
 import numpy as np
 
+from .resilient import DataIntegrityError
 from .unicore_dataset import UnicoreDataset
 
 logger = logging.getLogger(__name__)
@@ -63,7 +64,35 @@ class IndexedRecordDataset(UnicoreDataset):
         assert os.path.isfile(path + ".idx"), f"{path}.idx not found"
         self._offsets = np.fromfile(path + ".idx", dtype=np.int64)
         with open(path, "rb") as f:
-            assert f.read(len(_MAGIC)) == _MAGIC, f"{path}: bad magic"
+            if f.read(len(_MAGIC)) != _MAGIC:
+                raise DataIntegrityError(
+                    f"{path}: bad magic — not an IndexedRecordWriter file, "
+                    f"or its header bytes are corrupt"
+                )
+        # validate the offset table against the data file's real extents
+        # AT OPEN: a truncated .rec mmaps fine and would otherwise yield
+        # silently-truncated pickle bytes; a truncated .idx leaves a
+        # final offset short of the file end.  Either way: typed error
+        # at first touch, never garbage tensors later.
+        size = os.path.getsize(path)
+        if len(self._offsets) < 1 or self._offsets[0] != len(_MAGIC):
+            raise DataIntegrityError(
+                f"{path}.idx: offset table does not start at the header "
+                f"({self._offsets[:1]} != {len(_MAGIC)}) — the index file "
+                f"is torn or from a different store"
+            )
+        if np.any(np.diff(self._offsets) < 0):
+            raise DataIntegrityError(
+                f"{path}.idx: offsets are not monotonically increasing — "
+                f"the index file is corrupt"
+            )
+        if int(self._offsets[-1]) != size:
+            raise DataIntegrityError(
+                f"{path}: final index offset {int(self._offsets[-1])} != "
+                f"file size {size} — the data or index file is truncated "
+                f"(torn write / partial copy); re-copy or regenerate the "
+                f"pair"
+            )
         self._mmap = None
 
     def _data(self):
@@ -74,10 +103,34 @@ class IndexedRecordDataset(UnicoreDataset):
     def __len__(self):
         return len(self._offsets) - 1
 
+    def _record_span(self, idx):
+        """Bounds-checked (start, end) byte extents of record ``idx`` —
+        validated against BOTH the mapped length (stale index) and the
+        file's current on-disk size (a file shrunk after open would
+        otherwise SIGBUS on the fault-in of unmapped pages, which no
+        except clause can catch)."""
+        start, end = int(self._offsets[idx]), int(self._offsets[idx + 1])
+        if (not 0 <= start <= end <= len(self._data())
+                or end > os.path.getsize(self.path)):
+            raise DataIntegrityError(
+                f"{self.path}: record {idx} spans [{start}, {end}) outside "
+                f"the file's current extents (mapped {len(self._data())}, "
+                f"on disk {os.path.getsize(self.path)}) — the data file "
+                f"was truncated after open or the index is stale"
+            )
+        return start, end
+
     @lru_cache(maxsize=16)
     def __getitem__(self, idx):
-        start, end = self._offsets[idx], self._offsets[idx + 1]
-        return pickle.loads(self._data()[start:end].tobytes())
+        start, end = self._record_span(idx)
+        try:
+            return pickle.loads(self._data()[start:end].tobytes())
+        except (pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError, IndexError) as e:
+            raise DataIntegrityError(
+                f"{self.path}: record {idx} (bytes [{start}, {end})) does "
+                f"not unpickle — the record is torn: {e}"
+            ) from e
 
     def read_batch(self, indices):
         """Decode several records in one call.  With the native extension
@@ -91,10 +144,21 @@ class IndexedRecordDataset(UnicoreDataset):
                 int(self._offsets[i + 1] - self._offsets[i]) for i in indices
             ]
             return [
-                pickle.loads(b)
-                for b in _native.read_spans(self.path, starts, lens)
+                self._loads(b, int(i))
+                for i, b in zip(indices,
+                                _native.read_spans(self.path, starts, lens))
             ]
         return [self[int(i)] for i in indices]
+
+    def _loads(self, raw, idx):
+        try:
+            return pickle.loads(raw)
+        except (pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError, IndexError) as e:
+            raise DataIntegrityError(
+                f"{self.path}: record {idx} does not unpickle — the "
+                f"record is torn: {e}"
+            ) from e
 
     @property
     def supports_prefetch(self):

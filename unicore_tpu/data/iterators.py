@@ -19,19 +19,27 @@ GIL-bound for CPU-heavy transforms) and ``process`` (the reference's
 DataLoader-worker model, for tokenize-heavy pipelines).
 """
 
+import collections
 import itertools
 import logging
 import math
 import queue
 import threading
+import time
 import multiprocessing
-from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from concurrent.futures import BrokenExecutor, ProcessPoolExecutor, \
+    ThreadPoolExecutor
 
 import numpy as np
 
 from . import data_utils
 
 logger = logging.getLogger(__name__)
+
+# a crashed (SIGKILLed/OOM-killed) process-pool worker breaks the whole
+# executor; the stream respawns it with position restored, this many
+# times, before concluding the crash is deterministic and re-raising
+MAX_POOL_RESPAWNS = 3
 
 
 class CountingIterator:
@@ -171,21 +179,29 @@ class _EpochStream:
         self.total = len(plan)
         self.num_workers = num_workers
         self.buffer_size = buffer_size
+        self.impl = worker_impl() if num_workers > 0 else "inline"
+        self.respawns = 0
         self._iter = None
+        self._pump = None
         self._pool = None
-        if num_workers > 0 and worker_impl() == "process":
+        self._inflight_head = None  # dataset indices the consumer awaits
+        if num_workers > 0 and self.impl == "process":
             # fork the worker processes HERE, on the construction (main)
             # thread — _produce's generator body runs on the prefetch pump
             # thread when buffer_size > 0, and forking a multithreaded
             # process from a daemon thread is a deadlock window.  The
             # warmup submit forces the lazy fork to happen now.
-            self._pool = ProcessPoolExecutor(
-                max_workers=num_workers,
-                mp_context=multiprocessing.get_context("fork"),
-                initializer=_process_worker_init,
-                initargs=(dataset, collate_fn),
-            )
-            self._pool.submit(int, 0).result()
+            self._pool = self._make_pool()
+
+    def _make_pool(self):
+        pool = ProcessPoolExecutor(
+            max_workers=self.num_workers,
+            mp_context=multiprocessing.get_context("fork"),
+            initializer=_process_worker_init,
+            initargs=(self.dataset, self.collate_fn),
+        )
+        pool.submit(int, 0).result()
+        return pool
 
     def __len__(self):
         return self.total
@@ -204,6 +220,12 @@ class _EpochStream:
     def _load(self, indices):
         if len(indices) == 0:
             return {}  # lockstep dummy; trainer assigns it zero weight
+        if self.num_workers == 0:
+            # inline path only: under the thread pool this method runs
+            # on worker threads, and a healthy worker's write here
+            # would clobber the consumer-side "awaiting" marker the
+            # watchdog dump names (_pooled owns it there)
+            self._inflight_head = [int(i) for i in indices]
         # per-batch prefetch: wrapper stacks fan this down to the record
         # store, whose native readahead does the disk IO with the GIL
         # released — the per-item __getitem__ loop below then reads warm
@@ -225,18 +247,63 @@ class _EpochStream:
         else:
             source = map(self._load, todo)
         if self.buffer_size > 0:
-            source = _prefetch_thread(source, self.buffer_size)
+            self._pump = _PrefetchPump(source, self.buffer_size)
+            source = iter(self._pump)
         for batch in source:
             self.n += 1
             yield batch
 
-    def close(self):
-        """Tear down the forked worker pool (graceful-shutdown path: a
-        preemption save must not leave orphan worker processes behind
-        to be hard-killed by the supervisor after the grace window)."""
+    def status(self):
+        """One-line pipeline state for the step watchdog's timeout dump:
+        names the worker impl and the dataset indices of the batch the
+        consumer is stuck waiting on."""
+        bits = [f"impl={self.impl}", f"workers={self.num_workers}",
+                f"batch={self.n}/{self.total}"]
+        head = self._inflight_head
+        if head is not None:
+            bits.append(f"awaiting_indices={head[:12]}")
+        if self.respawns:
+            bits.append(f"respawns={self.respawns}")
+        if self._pump is not None:
+            bits.append(self._pump.status())
+        return "input(" + " ".join(bits) + ")"
+
+    def close(self, timeout=5.0):
+        """Tear the pipeline down within ``timeout`` seconds, leak-free
+        (graceful-shutdown path: a preemption save must not leave orphan
+        worker processes or a wedged prefetch pump behind to be
+        hard-killed by the supervisor after the grace window).  Order
+        matters: killing the pool first turns a pump blocked inside
+        ``future.result()`` into an exception it can exit on."""
+        deadline = time.monotonic() + timeout
         if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
-            self._pool = None
+            pool, self._pool = self._pool, None  # _pooled: None = closed
+            # snapshot the worker processes BEFORE shutdown clears the
+            # executor's table, so the terminate/join sweep below can
+            # actually reap them within the deadline
+            procs = list((getattr(pool, "_processes", None) or {}).values())
+            pool.shutdown(wait=False, cancel_futures=True)
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(max(0.0, deadline - time.monotonic()))
+            for p in procs:
+                if p.is_alive():  # wedged in an uninterruptible read
+                    p.kill()
+                    p.join(1.0)
+        if self._pump is not None:
+            pump, self._pump = self._pump, None
+            pump.stop(max(0.1, deadline - time.monotonic()))
+        if self._iter is not None:
+            # run _pooled's finally (thread-pool shutdown).  A generator
+            # mid-execution on the (now stopping) pump thread refuses
+            # close() with ValueError — the pump is already down and the
+            # daemon thread pool cannot outlive its cancelled futures.
+            it, self._iter = self._iter, None
+            try:
+                it.close()
+            except (ValueError, RuntimeError):
+                pass
 
     def _pooled(self, todo):
         """Materialize with a worker pool, at most ~2x workers in flight so
@@ -250,12 +317,18 @@ class _EpochStream:
           DataLoader-worker model, ``unicore/data/iterators.py:389-395``)
           — the dataset/collater ship to each worker once via the pool
           initializer, per-batch traffic is index lists in and pickled
-          numpy batches out.  Use for tokenize-heavy pipelines.
+          numpy batches out, and each batch carries the worker's
+          data-guard skip decisions back for the main process to commit
+          (``GuardedDataset.commit_health``).  A crashed worker (OOM
+          kill, segfault) breaks the executor: the stream respawns it —
+          bounded by MAX_POOL_RESPAWNS — and resubmits every
+          not-yet-yielded batch in order, so the consumer's position is
+          restored exactly.  Use for tokenize-heavy pipelines.
         """
         window = 2 * self.num_workers
-        if self._pool is not None:  # process pool, forked at __init__
-            pool = self._pool
-            submit = lambda b: pool.submit(
+        use_process = self._pool is not None  # forked at __init__
+        if use_process:
+            submit = lambda b: self._pool.submit(
                 _process_worker_load, [int(i) for i in b]
             )
         else:
@@ -263,18 +336,57 @@ class _EpochStream:
             submit = lambda b: pool.submit(self._load, b)
         try:
             backlog = iter(todo)
-            inflight = [
-                submit(b) for b in itertools.islice(backlog, window)
-            ]
-            inflight.reverse()  # pop() from the tail = FIFO order
+            inflight = collections.deque(
+                (submit(b), b) for b in itertools.islice(backlog, window)
+            )
             while inflight:
-                done = inflight.pop()
+                fut, batch_indices = inflight[0]
+                self._inflight_head = [int(i) for i in batch_indices]
+                try:
+                    res = fut.result()
+                except BrokenExecutor:
+                    if not use_process or self._pool is None:
+                        raise  # thread impl, or close() tore the pool down
+                    if self.respawns >= MAX_POOL_RESPAWNS:
+                        raise
+                    self._respawn_pool()
+                    # position restored: every batch not yet handed to
+                    # the consumer goes back in, in order
+                    inflight = collections.deque(
+                        (submit(b), b) for _, b in inflight
+                    )
+                    continue
+                inflight.popleft()
                 nxt = next(backlog, None)
                 if nxt is not None:
-                    inflight.insert(0, submit(nxt))
-                yield done.result()
+                    inflight.append((submit(nxt), nxt))
+                if use_process:
+                    batch, health = res
+                    if health is not None:
+                        commit = getattr(self.dataset, "commit_health", None)
+                        if commit is not None:
+                            commit(health)
+                    yield batch
+                else:
+                    yield res
         finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+            if not use_process:
+                pool.shutdown(wait=False, cancel_futures=True)
+
+    def _respawn_pool(self):
+        """Re-fork the process pool after a worker crash.  Forking from
+        the pump thread is the accepted risk here: recovery beats
+        purity, and the alternative is killing a run a supervisor would
+        restart from scratch anyway."""
+        self.respawns += 1
+        logger.warning(
+            "data worker pool broke (crashed worker process); respawning "
+            "%d/%d with position restored", self.respawns,
+            MAX_POOL_RESPAWNS,
+        )
+        old, self._pool = self._pool, None
+        old.shutdown(wait=False, cancel_futures=True)
+        self._pool = self._make_pool()
 
 
 _WORKER_IMPL = "thread"
@@ -295,39 +407,112 @@ def worker_impl():
 
 
 def _process_worker_init(dataset, collate_fn):
+    # runs INSIDE the worker.  A fork-context worker inherits the
+    # dataset as a memory COPY — __getstate__ never runs — so any
+    # canonical skip log came along for the ride; worker_init detaches
+    # it, making decisions buffer in the relay (_pending) instead of
+    # vanishing into the copy.
+    worker_init = getattr(dataset, "worker_init", None)
+    if worker_init is not None:
+        worker_init()
     _PROCESS_WORKER["dataset"] = dataset
     _PROCESS_WORKER["collate"] = collate_fn
 
 
 def _process_worker_load(indices):
-    if len(indices) == 0:
-        return {}  # lockstep dummy; trainer assigns it zero weight
+    """Returns ``(batch, health)``: the collated batch plus the worker's
+    drained data-guard decisions (skip entries + fetch/retry counts) for
+    the main process to fold into the canonical skip log — a forked
+    worker's ``GuardedDataset`` copy has no view of the global budget."""
     ds = _PROCESS_WORKER["dataset"]
-    return _PROCESS_WORKER["collate"]([ds[i] for i in indices])
+    if len(indices) == 0:
+        return {}, None  # lockstep dummy; trainer assigns it zero weight
+    batch = _PROCESS_WORKER["collate"]([ds[i] for i in indices])
+    drain = getattr(ds, "drain_health", None)
+    return batch, (drain() if drain is not None else None)
+
+
+_PUMP_DONE = object()
+
+
+class _PrefetchPump:
+    """Bounded background prefetch of an iterator on a daemon thread.
+
+    The supervised version of the old ``_prefetch_thread`` closure:
+    ``stop()`` tears it down within a deadline (the graceful-shutdown
+    leak-free contract — a blocked ``put`` unblocks via a stop-aware
+    timeout loop plus a consumer-side drain), and ``status()`` reports
+    depth/progress/idle time for the step watchdog's timeout dump."""
+
+    def __init__(self, source, depth, name="unicore-data-prefetch"):
+        self._source = source
+        self._q = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self.items = 0
+        self.last_put = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def _put(self, item):
+        """Queue.put that gives up when stop() was requested."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _pump(self):
+        try:
+            for item in self._source:
+                if not self._put(item):
+                    return
+                self.items += 1
+                self.last_put = time.monotonic()
+        except Exception as e:
+            self._put(e)
+            return
+        self._put(_PUMP_DONE)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _PUMP_DONE:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    def status(self):
+        idle = time.monotonic() - self.last_put
+        return (
+            f"prefetch(depth={self._q.qsize()} produced={self.items} "
+            f"idle={idle:.1f}s alive={self._thread.is_alive()})"
+        )
+
+    def stop(self, timeout=5.0):
+        """Signal the pump down and join it; drains the queue so a
+        blocked producer-side ``put`` unblocks.  Returns True when the
+        thread exited within the deadline (a worker wedged inside the
+        source cannot be interrupted — the daemon thread is abandoned
+        and the caller's deadline still holds)."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        return not self._thread.is_alive()
 
 
 def _prefetch_thread(source, depth):
     """Generator view of ``source`` pumped by a daemon thread."""
-    q = queue.Queue(maxsize=depth)
-    DONE = object()
-
-    def pump():
-        try:
-            for item in source:
-                q.put(item)
-        except Exception as e:
-            q.put(e)
-            return
-        q.put(DONE)
-
-    threading.Thread(target=pump, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is DONE:
-            return
-        if isinstance(item, Exception):
-            raise item
-        yield item
+    return iter(_PrefetchPump(source, depth))
 
 
 class EpochBatchIterator:
@@ -450,12 +635,22 @@ class EpochBatchIterator:
     def end_of_epoch(self) -> bool:
         return self._active is not None and not self._active.has_next()
 
-    def close(self):
-        """Shut down the active/resumed streams' worker pools (called by
-        the train loop on graceful preemption exit)."""
+    def close(self, timeout=5.0):
+        """Shut down the active/resumed streams' worker pools and
+        prefetch pumps within a deadline (called by the train loop on
+        graceful preemption exit — the grace window is for persisting
+        state, not for waiting on wedged workers)."""
         for stream in (self._active, self._resumed):
             if stream is not None:
-                stream.close()
+                stream.close(timeout)
+
+    def status(self):
+        """Input-pipeline state line for the step watchdog's timeout
+        dump (worker impl, position, awaited dataset indices)."""
+        stream = self._active or self._resumed
+        if stream is None:
+            return f"input(idle epoch={self.epoch})"
+        return stream.status()
 
     # -- checkpoint state ----------------------------------------------
 
@@ -464,16 +659,30 @@ class EpochBatchIterator:
             epoch, position = self.epoch + 1, 0
         else:
             epoch, position = self.epoch, self.iterations_in_epoch
-        return {
+        state = {
             "version": 2,
             "epoch": epoch,
             "iterations_in_epoch": position,
             "shuffle": self.shuffle,
             "len": len(self),
         }
+        # the data guard's skip log rides the checkpoint: a resumed run
+        # must carry the same budget arithmetic and (epoch, index) dedup
+        # set, or replayed skips would double-count and the chaos
+        # harness's oracle comparison would drift
+        skip_log = getattr(self.dataset, "skip_log", None)
+        if skip_log is not None:
+            state["data_guard"] = skip_log.state_dict()
+        return state
 
     def load_state_dict(self, state_dict):
         self.epoch = state_dict["epoch"]
+        skip_log = getattr(self.dataset, "skip_log", None)
+        if skip_log is not None and "data_guard" in state_dict:
+            # BEFORE the stream is built below: the process worker fork
+            # snapshots the dataset, and the main-process log must hold
+            # the saved entries before any resumed batch commits new ones
+            skip_log.load_state_dict(state_dict["data_guard"])
         position = state_dict.get("iterations_in_epoch", 0)
         saved_len = state_dict.get("len")
         if saved_len not in (None, len(self)) and position > 0:

@@ -13,6 +13,7 @@ import os
 import pickle
 from functools import lru_cache
 
+from .resilient import DataIntegrityError
 from .unicore_dataset import UnicoreDataset
 
 try:
@@ -60,5 +61,26 @@ class LMDBDataset(UnicoreDataset):
     def __getitem__(self, idx):
         if self._env is None:
             self.connect_db(self.db_path, save_to_self=True)
-        datapoint_pickled = self._env.begin().get(self._keys[idx])
-        return pickle.loads(datapoint_pickled)
+        try:
+            datapoint_pickled = self._env.begin().get(self._keys[idx])
+        except lmdb.Error as e:  # torn page / failed read
+            raise DataIntegrityError(
+                f"{self.db_path}: LMDB read failed for record {idx} "
+                f"(key {self._keys[idx]!r}): {e}"
+            ) from e
+        if datapoint_pickled is None:
+            # the key was scanned at construction — a None get means the
+            # record vanished or the page holding it is torn
+            raise DataIntegrityError(
+                f"{self.db_path}: LMDB get returned None for record "
+                f"{idx} (key {self._keys[idx]!r}) — the record is "
+                f"missing or its page is corrupt"
+            )
+        try:
+            return pickle.loads(datapoint_pickled)
+        except (pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError, IndexError) as e:
+            raise DataIntegrityError(
+                f"{self.db_path}: LMDB record {idx} does not unpickle — "
+                f"the record is torn: {e}"
+            ) from e

@@ -274,7 +274,13 @@ class Trainer:
         # invocation = every host-side thing between dispatches (stats
         # bookkeeping, staging, boundary checks, save capture)
         self.host_timers = {"step_boundary_host_s": 0.0,
-                            "step_boundaries": 0}
+                            "step_boundaries": 0,
+                            # boundary waits on the staged batch (train
+                            # loop _next_staged): isolates data-pipeline
+                            # stalls from device step time for bench's
+                            # input_stall_ms
+                            "input_wait_s": 0.0,
+                            "input_waits": 0}
         self._boundary_started = None
         # background checkpoint writer (attached by the CLI from the
         # CheckpointManager): consulted by the rewind interlock and the
@@ -313,6 +319,12 @@ class Trainer:
         self._watchdog = StepWatchdog(
             float(getattr(args, "step_timeout", 0) or 0)
         )
+        # the timeout dump's context line composes every attached status
+        # source: the background checkpoint writer (a slow write must not
+        # read as a hung device step) and the input pipeline (a wedged
+        # data worker names its impl + the stuck dataset indices)
+        self._input_status = None
+        self._watchdog.context = self._watchdog_context
         traj_path = getattr(args, "trajectory_file", None)
         self._trajectory = TrajectoryWriter(traj_path) if traj_path else None
         # chaos-only fault injection (the harness's hook into the REAL
@@ -1259,12 +1271,33 @@ class Trainer:
 
     def attach_checkpoint_writer(self, writer):
         """Wire the CheckpointManager's background writer in: the
-        watchdog's timeout dump then names the writer's state (a slow
-        background write must not read as a hung device step), and the
-        rewind ladder serializes against in-flight saves."""
+        watchdog's timeout dump then names the writer's state (via
+        :meth:`_watchdog_context`; a slow background write must not read
+        as a hung device step), and the rewind ladder serializes against
+        in-flight saves."""
         self._ckpt_writer = writer
-        if writer is not None:
-            self._watchdog.context = writer.status
+
+    def attach_input_pipeline(self, status_fn):
+        """Wire the data pipeline's status hook (EpochBatchIterator
+        ``status``) into the watchdog's timeout dump: a timeout that
+        fires while the loop waits on a staged batch names the worker
+        impl and the stuck dataset indices."""
+        self._input_status = status_fn
+
+    def _watchdog_context(self):
+        parts = []
+        if self._ckpt_writer is not None:
+            parts.append(str(self._ckpt_writer.status()))
+        if self._input_status is not None:
+            parts.append(str(self._input_status()))
+        return " | ".join(parts) or "no context sources attached"
+
+    def input_wait(self, phase="train/data-wait"):
+        """Watchdog arming for the train loop's pull of the next batch
+        group — a wedged data worker or prefetch pump must trip the same
+        hang detection as a wedged device step (the dump's context names
+        the pipeline state)."""
+        return self._watchdog.armed(phase)
 
     def _record_trajectory(self, stats, dispatch_idx, action):
         if self._trajectory is None:

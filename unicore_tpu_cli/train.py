@@ -17,6 +17,7 @@ import logging
 import math
 import os
 import sys
+import time
 from typing import Optional
 
 import numpy as np
@@ -67,6 +68,11 @@ class TrainLoop:
         # patience tracking (reference should_stop_early, train.py:147-172)
         self._runs_without_improvement = 0
         self._patience_best = None
+        # data-guard counter watermarks for the delta-based
+        # data_skipped/data_retries/data_corrupt_rate metrics; None
+        # until the first boundary snapshots a baseline — a resumed
+        # run's restored skip-log history must not read as fresh skips
+        self._data_seen = None
 
     # -- stop conditions ----------------------------------------------
 
@@ -157,6 +163,13 @@ class TrainLoop:
         itr = iterators.GroupedIterator(itr, update_freq)
         progress = self._progress(itr, epoch_itr.epoch)
 
+        # the watchdog's timeout dump names this epoch's pipeline state
+        # (worker impl + stuck dataset indices) next to the writer's
+        self.trainer.attach_input_pipeline(getattr(epoch_itr, "status", None))
+        # baseline the data-guard watermark BEFORE the first pull: a
+        # restored skip log's history must not count as fresh skips,
+        # while a skip in the very first batch still must
+        self._log_data_health(epoch_itr)
         self.trainer.begin_epoch(epoch_itr.epoch)
         valid_losses, stop = [None], False
         num_updates = self.trainer.get_num_updates()
@@ -209,12 +222,50 @@ class TrainLoop:
 
     def _next_staged(self, stream):
         """Pull the next micro-batch group and stage it onto the device
-        (overlaps the currently-executing step); None at epoch end."""
-        samples = next(stream, None)
+        (overlaps the currently-executing step); None at epoch end.
+
+        The pull is armed on the step watchdog (a wedged worker or
+        prefetch pump is a hang like any other; the dump names the
+        pipeline state) and timed into ``host_timers`` — the
+        steady-state wait here is bench's ``input_stall_ms``, the
+        data-pipeline stall isolated from device step time."""
+        t0 = time.perf_counter()
+        with self.trainer.input_wait():
+            samples = next(stream, None)
+        ht = self.trainer.host_timers
+        ht["input_wait_s"] += time.perf_counter() - t0
+        ht["input_waits"] += 1
         if samples is None:
             return None
         with jax.profiler.TraceAnnotation("train/stage"):
             return self.trainer.stage_batches(samples)
+
+    def _log_data_health(self, epoch_itr):
+        """Data-guard metrics, polled on the MAIN thread each boundary
+        (worker threads/processes must not touch the metrics
+        aggregators): deltas of the skip/retry counters plus the
+        corrupt-rate gauge the budget ladder watches."""
+        counters_fn = getattr(epoch_itr.dataset, "data_counters", None)
+        if counters_fn is None:
+            return
+        c = counters_fn()
+        if c is None:
+            return
+        if self._data_seen is None:  # first boundary: baseline only
+            self._data_seen = {k: c[k] for k in ("skipped", "retries")}
+            return
+        d_skip = c["skipped"] - self._data_seen["skipped"]
+        d_retry = c["retries"] - self._data_seen["retries"]
+        if d_skip > 0:
+            metrics.log_scalar("data_skipped", d_skip, priority=612, round=0)
+        if d_retry > 0:
+            metrics.log_scalar("data_retries", d_retry, priority=613, round=0)
+        if d_skip > 0 or d_retry > 0:
+            metrics.log_scalar(
+                "data_corrupt_rate", c["corrupt_rate"], priority=614,
+                round=5, weight=0,
+            )
+            self._data_seen = {k: c[k] for k in ("skipped", "retries")}
 
     def validate_and_save(self, epoch_itr, end_of_epoch):
         args = self.args
@@ -223,6 +274,7 @@ class TrainLoop:
         # else this round — the run must never keep training on the
         # belief that a save landed when it did not
         self.ckpt.poll()
+        self._log_data_health(epoch_itr)
         # preemption (SIGTERM/SIGINT): flush the lagged pipeline so the
         # checkpoint carries exact counts, write it, and stop — the save
         # rides the normal do_save=stop path below; validation is skipped
