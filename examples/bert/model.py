@@ -26,20 +26,28 @@ from unicore_tpu.utils import arg_bool, eval_bool, get_activation_fn
 
 
 class BertLMHead(nn.Module):
-    """Masked-LM head with tied embedding projection."""
+    """Masked-LM head with tied embedding projection.
+
+    ``fused=True`` returns the pre-projection features plus the tied
+    kernel and bias instead of materialized logits, so the loss can run
+    the vocab projection chunk-by-chunk
+    (``ops/fused_cross_entropy.py``).  Both modes create the identical
+    parameter set — a checkpoint trained one way restores the other.
+    """
 
     embed_dim: int
     output_dim: int
     activation_fn: str
 
     @nn.compact
-    def __call__(self, features, embed_attend):
+    def __call__(self, features, embed, fused=False):
         x = nn.Dense(self.embed_dim, kernel_init=bert_init, name="dense")(features)
         x = get_activation_fn(self.activation_fn)(x)
         x = LayerNorm(self.embed_dim, name="layer_norm")(x)
-        x = embed_attend(x)
         bias = self.param("bias", nn.initializers.zeros, (self.output_dim,))
-        return x + bias
+        if fused:
+            return x, embed.embedding, bias
+        return embed.attend(x) + bias
 
 
 class BertClassificationHead(nn.Module):
@@ -78,6 +86,10 @@ def _embed_init_with_zero_pad(padding_idx):
 
 @register_model("bert")
 class BertModel(BaseUnicoreModel):
+    # losses may request the fused-head output form (features + tied
+    # kernel + bias) via ``fused_head=True``; see BertLMHead
+    supports_fused_head = True
+
     vocab_size: int = 30522
     padding_idx: int = 0
     encoder_layers: int = 12
@@ -138,6 +150,17 @@ class BertModel(BaseUnicoreModel):
                                  "(static-shape masked-token-only vocab "
                                  "projection; 0 = project every position)")
 
+    @staticmethod
+    def slot_count(bsz, seq_len, capacity):
+        """Static LM-head slot budget for a [bsz, seq_len] batch: the
+        capacity fraction, floored at 8, rounded up to a 128-multiple
+        (MXU tile), capped at every position.  Shared with the
+        fused-head memory audit (analysis/scenarios.py) so its UL002
+        budget tracks the rows the head actually projects."""
+        k = int(round(bsz * seq_len * capacity))
+        k = max(min(k, bsz * seq_len), 8)
+        return min(-(-k // 128) * 128, bsz * seq_len)
+
     @classmethod
     def build_model(cls, args, task):
         return cls(
@@ -172,6 +195,7 @@ class BertModel(BaseUnicoreModel):
         features_only=False,
         classification_head_name=None,
         deterministic=True,
+        fused_head=False,
         **kwargs,
     ):
         if classification_head_name is not None:
@@ -226,20 +250,33 @@ class BertModel(BaseUnicoreModel):
                 # rare at K = capacity * B * T >= ~1.6x the expected count)
                 # drops the excess positions from the loss.
                 bsz, seq_len = src_tokens.shape
-                k_slots = int(round(bsz * seq_len * self.masked_loss_capacity))
-                k_slots = max(min(k_slots, bsz * seq_len), 8)
-                k_slots = min(-(-k_slots // 128) * 128, bsz * seq_len)
+                k_slots = self.slot_count(bsz, seq_len,
+                                          self.masked_loss_capacity)
                 flat_mask = masked_tokens.reshape(-1).astype(jnp.int32)
                 _, slot_index = jax.lax.top_k(flat_mask, k_slots)
                 slot_valid = flat_mask[slot_index] > 0
                 feats = x.reshape(bsz * seq_len, -1)[slot_index]
-                logits = lm_head(feats, embed.attend)
+                if fused_head:
+                    h, kernel, bias = lm_head(feats, embed, fused=True)
+                    return {
+                        "features": h,             # [K, C] pre-projection
+                        "kernel": kernel,          # [V, C] tied embedding
+                        "bias": bias,              # [V]
+                        "tied": True,
+                        "slot_index": slot_index,  # [K] into the flat [B*T]
+                        "slot_valid": slot_valid,  # [K] bool
+                    }
+                logits = lm_head(feats, embed)
                 return {
                     "logits": logits,          # [K, V]
                     "slot_index": slot_index,  # [K] into the flat [B*T]
                     "slot_valid": slot_valid,  # [K] bool
                 }
-            x = lm_head(x, embed.attend)
+            if fused_head:
+                h, kernel, bias = lm_head(x, embed, fused=True)
+                return {"features": h, "kernel": kernel, "bias": bias,
+                        "tied": True}
+            x = lm_head(x, embed)
         if classification_head_name is not None:
             x = BertClassificationHead(
                 inner_dim=self.encoder_embed_dim,

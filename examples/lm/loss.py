@@ -14,6 +14,8 @@ import jax.numpy as jnp
 
 from unicore_tpu import metrics
 from unicore_tpu.losses import UnicoreLoss, register_loss
+from unicore_tpu.losses.unicore_loss import fused_head_request
+from unicore_tpu.ops.fused_cross_entropy import fused_head_nll
 
 
 @register_loss("lm_cross_entropy")
@@ -25,17 +27,22 @@ class LMCrossEntropyLoss(UnicoreLoss):
     def forward(self, model, params, sample, rng=None, is_training=True):
         target = sample["target"]
         weight = (target != self.padding_idx).astype(jnp.float32)
-        logits = model.apply(
+        fused, ce_chunk = fused_head_request(self, model)
+        out = model.apply(
             {"params": params},
             **sample["net_input"],
             deterministic=not is_training,
             rngs={"dropout": rng} if (is_training and rng is not None) else None,
+            **({"fused_head": True} if fused else {}),
         )
-        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(
-            lprobs, jnp.where(target != self.padding_idx, target, 0)[..., None],
-            axis=-1,
-        )[..., 0]
+        tgt = jnp.where(target != self.padding_idx, target, 0)
+        if isinstance(out, dict) and "features" in out:
+            # fused chunked head: [B*T, V] logits never materialize
+            nll = fused_head_nll(out, tgt, chunk_size=ce_chunk) \
+                .reshape(target.shape)
+        else:
+            lprobs = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(lprobs, tgt[..., None], axis=-1)[..., 0]
         loss = jnp.sum(nll * weight)
         sample_size = jnp.sum(weight)
         logging_output = {

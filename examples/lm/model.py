@@ -29,6 +29,11 @@ def _embed_init_with_zero_pad(padding_idx):
 
 @register_model("transformer_lm")
 class TransformerLMModel(BaseUnicoreModel):
+    # losses may request the fused-head output form (features + tied
+    # kernel + bias) via ``fused_head=True`` instead of materialized
+    # [B, T, V] logits (ops/fused_cross_entropy.py)
+    supports_fused_head = True
+
     vocab_size: int = 30522
     padding_idx: int = 0
     decoder_layers: int = 6
@@ -145,7 +150,7 @@ class TransformerLMModel(BaseUnicoreModel):
 
     @nn.compact
     def __call__(self, src_tokens, deterministic=True, decode=False,
-                 positions=None, paged=None, **kwargs):
+                 positions=None, paged=None, fused_head=False, **kwargs):
         # decoding assumes unpadded OR right-padded prompts (generate()
         # enforces; a 2-D positions array carries the per-sequence
         # offsets); the decoder drops the key-padding mask on the decode
@@ -195,9 +200,13 @@ class TransformerLMModel(BaseUnicoreModel):
         # tied projection + final LN'd features -> logits
         x = LayerNorm(self.decoder_embed_dim, name="out_layer_norm")(x)
         x = get_activation_fn(self.activation_fn)(x)
-        logits = embed.attend(x)
         bias = self.param("out_bias", nn.initializers.zeros, (self.vocab_size,))
-        return logits + bias
+        if fused_head:
+            # pre-projection features + tied kernel: the loss runs the
+            # vocab matmul chunk-by-chunk so [B, T, V] never materializes
+            return {"features": x, "kernel": embed.embedding, "bias": bias,
+                    "tied": True}
+        return embed.attend(x) + bias
 
 
 @register_model_architecture("transformer_lm", "transformer_lm")

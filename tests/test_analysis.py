@@ -477,6 +477,34 @@ def test_flagship_bert_trace_audit_clean():
     assert ran == ["dp", "fsdp2", "tp2", "seq2", "tp2_fsdp2"], reports
 
 
+def test_fused_head_audit_silent_fused_fires_materialized():
+    """ISSUE 10 acceptance: with UL002's budget pinned to the head's
+    full-logits byte size (rows * vocab * 4), the DEFAULT (fused
+    chunked) train step must be silent on every pass-3 mesh variant —
+    no intermediate that large exists in forward or backward — while
+    the materialized head (--fused-lm-head off) must fire on each, the
+    tripwire proving the budget bites at audit shapes."""
+    import os
+
+    from unicore_tpu.analysis.scenarios import (
+        MESH_VARIANTS,
+        PASS3_VARIANTS,
+        audit_fused_head_memory,
+    )
+
+    variants = [v for v in MESH_VARIANTS if v[0] in PASS3_VARIANTS]
+    results = audit_fused_head_memory(
+        os.path.join(_repo_root(), "examples", "bert"),
+        variants=variants, n_devices=8,
+    )
+    assert sorted(results) == sorted(PASS3_VARIANTS), results
+    for name, per in results.items():
+        assert per["fused"] == [], (
+            name, "\n".join(f.render() for f in per["fused"]))
+        assert any(f.rule == "UL002" for f in per["naive"]), (
+            name, "materialized head did not trip the logits budget")
+
+
 def test_trainer_trace_audit_catches_seeded_sharding_hole():
     """End-to-end negative control: force a hole through the REAL
     trainer artifacts and assert the audit sees it (guards against the

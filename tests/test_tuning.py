@@ -530,6 +530,112 @@ def test_forced_config_context(tune_env):
     assert tuning.flash_decision((1, 256, 1, 64), 256, "float32") is None
 
 
+# ---------------------------------------------------------------------------
+# fused chunked linear+cross-entropy (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def test_ce_bucket_rounds_rows_vocab_keeps_hidden():
+    b = candidates.OPS["fused_cross_entropy"].bucket
+    # rows/vocab pow2-bucket (8192 covers 8000), hidden stays exact
+    assert b(tuning.ce_workload(8000, 768, 30528, "bfloat16")) == \
+        b(tuning.ce_workload(8192, 768, 32768, "bfloat16"))
+    assert b(tuning.ce_workload(8192, 768, 30528, "bfloat16")) != \
+        b(tuning.ce_workload(8192, 1024, 30528, "bfloat16"))
+    assert b(tuning.ce_workload(8192, 768, 30528, "bfloat16", tied=False)) \
+        != b(tuning.ce_workload(8192, 768, 30528, "bfloat16", tied=True))
+
+
+def test_ce_candidates_eager_always_chunks_bounded():
+    wl = tuning.ce_workload(8192, 768, 30528, "bfloat16")
+    cands = candidates.OPS["fused_cross_entropy"].candidates(wl)
+    assert cands[0] == "eager"
+    chunks = [c["chunk"] for c in cands[1:]]
+    assert chunks and all(1 <= c <= wl["rows"] for c in chunks)
+    assert len(set(chunks)) == len(chunks)
+    # the op's own heuristic pick is always in the running
+    from unicore_tpu.ops.fused_cross_entropy import pick_chunk
+
+    assert pick_chunk(wl["rows"], wl["vocab"]) in chunks
+
+
+def test_tuned_ce_chunk_validation():
+    assert tuning.tuned_ce_chunk(1024, {"chunk": 256}) == 256
+    assert tuning.tuned_ce_chunk(128, {"chunk": 256}) == 128  # clamped
+    assert tuning.tuned_ce_chunk(1024, {"chunk": 0}) is None
+    assert tuning.tuned_ce_chunk(1024, "eager") is None
+    assert tuning.tuned_ce_chunk(1024, None) is None
+    assert tuning.tuned_ce_chunk(1024, {"q_blk": 64}) is None
+
+
+def test_ce_cached_verdicts_steer_dispatch(tune_env):
+    """A cached {"chunk": n} reaches the op's chunk resolution; a cached
+    "eager" retires the fused path for the bucket."""
+    from unicore_tpu.ops import fused_cross_entropy as fce
+
+    rows, hidden, vocab = 4096, 64, 512
+    wl = tuning.ce_workload(rows, hidden, vocab, "float32")
+    key = bucket_key(candidates.OPS["fused_cross_entropy"].bucket(wl))
+
+    tune_env.record(key, {"chunk": 96})
+    tuning.reset_memo()
+    assert fce._resolve_chunk(rows, hidden, vocab, "float32", True,
+                              True) == 96
+    tune_env.record(key, "eager")
+    tuning.reset_memo()
+    assert fce._resolve_chunk(rows, hidden, vocab, "float32", True,
+                              True) is None
+    # a miss past FUSE_MIN_BYTES falls to the byte heuristic (vocab
+    # 8192 -> chunk 1024 < rows, a genuinely chunkable bucket)
+    other = tuning.ce_workload(rows, hidden, 8192, "float32")
+    assert bucket_key(
+        candidates.OPS["fused_cross_entropy"].bucket(other)) != key
+    assert fce._resolve_chunk(rows, hidden, 8192, "float32", True, True) \
+        == fce.pick_chunk(rows, 8192)
+
+
+def test_ce_runner_builds_fused_and_eager(tune_env):
+    """Both candidate runners AOT-compile (the dry-run path CI walks)."""
+    wl = candidates.OPS["fused_cross_entropy"].shrink(
+        tuning.PRESETS["fused_ce_bert"]
+    )
+    for config in ("eager", {"chunk": 64}):
+        fn = candidates.OPS["fused_cross_entropy"].build_runner(wl, config)
+        out = fn()
+        assert all(np.all(np.isfinite(np.asarray(x))) for x in out)
+
+
+def test_evoformer_static_verdict_out_of_the_box(tune_env):
+    """The BENCH_r05 evoformer bucket (~0.99x kernel-vs-eager) carries a
+    committed "eager" verdict: with an EMPTY cache, dispatch must route
+    to eager for both dropout states — and a measured cache entry must
+    still override the static verdict."""
+    mask = ((1, 128, 1, 1, 128), "bfloat16")
+    bias = ((1, 1, 4, 128, 128), "bfloat16")
+    for dropout_on in (True, False):
+        assert tuning.softmax_dropout_decision(
+            (1, 128, 4, 128, 128), "bfloat16", mask=mask, bias=bias,
+            dropout_on=dropout_on,
+        ) == "eager"
+    # a different (winning) bucket stays on the heuristics
+    assert tuning.softmax_dropout_decision(
+        (32, 12, 512, 512), "bfloat16",
+        bias=((1, 12, 512, 512), "bfloat16"), dropout_on=True,
+    ) is None
+    wl = tuning.sd_workload(
+        (1, 128, 4, 128, 128), "bfloat16", mask=mask, bias=bias,
+        dropout_on=True,
+    )
+    key = bucket_key(candidates.OPS["softmax_dropout"].bucket(wl))
+    assert key in tuning.STATIC_VERDICTS
+    tune_env.record(key, {"q_blk": 128})
+    tuning.reset_memo()
+    assert tuning.softmax_dropout_decision(
+        (1, 128, 4, 128, 128), "bfloat16", mask=mask, bias=bias,
+        dropout_on=True,
+    ) == {"q_blk": 128}
+
+
 def test_cli_dry_run_roundtrip(tmp_path, capsys):
     """End-to-end CLI: tune --dry-run twice against one cache file; the
     second report shows zero re-timings; `cache` mode reads it back."""

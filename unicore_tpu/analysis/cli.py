@@ -112,6 +112,14 @@ def build_parser():
              "fingerprint with this run's measurements before the "
              "budget rules evaluate (the accepted-change workflow)",
     )
+    p.add_argument(
+        "--fused-head-audit", action="store_true",
+        help="certify the fused LM head's memory contract on --config: "
+             "per mesh variant, re-trace the train step with UL002's "
+             "budget set to the head's full-logits byte size — the "
+             "fused default must be silent, the materialized head must "
+             "fire (exit 1 otherwise)",
+    )
     p.add_argument("--json", default=None, metavar="FILE",
                    help="also write the report as JSON")
     p.add_argument(
@@ -157,7 +165,7 @@ def main(argv=None):
 
     needs_jax = (
         (args.config and not args.no_trace) or args.pass3
-        or args.pass3_serve
+        or args.pass3_serve or args.fused_head_audit
     )
     if needs_jax and args.cpu_devices:
         _provision_cpu_devices(args.cpu_devices)
@@ -177,6 +185,36 @@ def main(argv=None):
         for r in trace_reports:
             if "skipped" in r:
                 log(f"variant {r['variant']}: SKIPPED ({r['skipped']})")
+
+    fused_head_failed = False
+    fused_head_report = None
+    if args.fused_head_audit:
+        if not args.config:
+            print("unicore-lint: error: --fused-head-audit needs --config",
+                  file=sys.stderr)
+            return 2
+        from unicore_tpu.analysis.scenarios import audit_fused_head_memory
+
+        results = audit_fused_head_memory(
+            args.config, log=log, n_devices=args.cpu_devices or None,
+        )
+        fused_head_report = []
+        for name, per in sorted(results.items()):
+            ok = not per["fused"] and bool(per["naive"])
+            fused_head_failed = fused_head_failed or not ok
+            fused_head_report.append({
+                "variant": name, "rows": per["rows"],
+                "budget_bytes": per["budget_bytes"], "ok": ok,
+                "fused_findings": [f.message for f in per["fused"]],
+                "naive_fires": len(per["naive"]),
+            })
+            print(
+                f"fused-head audit bert/{name}: "
+                f"{'PASS' if ok else 'FAIL'} (budget "
+                f"{per['budget_bytes'] >> 10} KiB: fused "
+                f"{len(per['fused'])} finding(s), materialized "
+                f"{len(per['naive'])})"
+            )
 
     if args.pass3 or args.pass3_serve:
         from unicore_tpu.analysis import hlo_audit
@@ -293,6 +331,8 @@ def main(argv=None):
     extra = {"trace": trace_reports}
     if pass3_report is not None:
         extra["pass3"] = pass3_report
+    if fused_head_report is not None:
+        extra["fused_head_audit"] = fused_head_report
     if stale:
         extra["stale_baseline"] = stale
     if args.json:
@@ -304,7 +344,9 @@ def main(argv=None):
     if stale:
         print(f"unicore-lint: {len(stale)} stale baseline "
               f"suppression(s) (baseline rot)")
-    return 1 if (new or stale) else 0
+    if fused_head_failed:
+        print("unicore-lint: fused-head memory audit FAILED")
+    return 1 if (new or stale or fused_head_failed) else 0
 
 
 if __name__ == "__main__":

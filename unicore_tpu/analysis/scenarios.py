@@ -60,6 +60,12 @@ def base_args(**overrides):
         warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
         fp16_init_scale=4.0, max_update=10, max_epoch=0,
         tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+        # the audited program is the PRODUCTION default (fused chunked
+        # LM head) — with an explicit small chunk so the scan is real at
+        # audit shapes (the auto heuristic would take the unfused path
+        # below FUSE_MIN_BYTES, and a chunk >= rows degenerates to one
+        # full-logits chunk; 32 keeps rows/chunk >= 4 here)
+        fused_lm_head="on", fused_ce_chunk=32,
     )
     for k, v in overrides.items():
         setattr(args, k, v)
@@ -120,7 +126,7 @@ def _load_bert_model(example_dir, vocab, *, layers, dim, ffn, heads, seq):
 
 def build_bert_scenario(example_dir, overrides=None, devices=None, *,
                         seq=16, layers=2, dim=64, ffn=128, heads=4,
-                        batch_size=8):
+                        batch_size=8, vocab=64):
     """(trainer, samples, meta) for one mesh variant of the bert config.
 
     Installs the variant's mesh as the cached global mesh (the Trainer
@@ -134,12 +140,15 @@ def build_bert_scenario(example_dir, overrides=None, devices=None, *,
 
     args = base_args(**(overrides or {}))
 
-    # 59 + [MASK] + 4 base specials = 64 symbols: even vocab so the
-    # vocab-parallel embedding sharding engages under tensor variants
+    # default 59 + [MASK] + 4 base specials = 64 symbols: even vocab so
+    # the vocab-parallel embedding sharding engages under tensor
+    # variants (the fused-head memory audit passes a larger ``vocab`` so
+    # the head dominates every other buffer)
     d = Dictionary()
-    for i in range(59):
+    for i in range(vocab - 5):
         d.add_symbol(f"tok{i}")
     mask_idx = d.add_symbol("[MASK]", is_special=True)
+    assert len(d) == vocab, len(d)
 
     class _Task(UnicoreTask):
         def __init__(self, a):
@@ -403,6 +412,70 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
                 ctx, stats.get("peak_bytes"), entry, tolerance=tol
             ))
     return findings, {"fingerprint": fp, "scenarios": scenarios_report}
+
+
+def audit_fused_head_memory(example_dir, *, variants=None, n_devices=None,
+                            vocab=3072, log=None):
+    """Certify the fused LM head's memory contract (ISSUE 10): per mesh
+    variant, trace the REAL jitted train step with UL002's absolute
+    budget set to the head's full-logits byte size (``rows * vocab * 4``)
+    and a vocab large enough that every legitimate buffer (params,
+    moments, activations) sits below it.
+
+    - the production default (fused chunked head) must be SILENT: no
+      intermediate as large as the materialized logits exists anywhere
+      in forward or backward;
+    - the same scenario with ``fused_lm_head="off"`` must FIRE — the
+      tripwire proving the threshold actually bites at these shapes.
+
+    Returns ``{variant: {"rows": K, "budget_bytes": B,
+    "fused": [Finding...], "naive": [Finding...]}}``.  Callers assert
+    fused == [] and naive != [] (tests/test_analysis.py; the CLI's
+    ``--fused-head-audit`` prints a pass/fail table).
+    """
+    import jax
+
+    from unicore_tpu.analysis.trace_audit import audit_jaxpr
+
+    avail = jax.devices()
+    if n_devices is None:
+        n_devices = min(8, len(avail))
+    devices = avail[:n_devices]
+    results = {}
+    snap = snapshot_globals()
+    try:
+        for name, overrides, min_dev in (variants or MESH_VARIANTS):
+            if len(devices) < min_dev or len(devices) % max(min_dev, 1):
+                continue
+            per = {}
+            for mode in ("fused", "naive"):
+                ov = dict(overrides)
+                if mode == "naive":
+                    ov["fused_lm_head"] = "off"
+                trainer, samples, meta = build_bert_scenario(
+                    example_dir, ov, devices, vocab=vocab,
+                )
+                bsz, seq = samples[0]["target"].shape
+                # rows the head actually projects, from the MODEL's own
+                # slot arithmetic (capacity changes track automatically)
+                model = trainer.model
+                rows = model.slot_count(bsz, seq,
+                                        model.masked_loss_capacity)
+                budget = rows * vocab * 4
+                if log:
+                    log(f"fused-head audit: tracing bert/{name} [{mode}] "
+                        f"(budget {budget >> 10} KiB)")
+                art = trainer.trace_train_step(samples)
+                per[mode] = audit_jaxpr(
+                    art["jaxpr"], context=f"bert/{name}/{mode}",
+                    seq_len=meta["seq_len"], big_bytes=budget,
+                    quad_bytes=budget,
+                )
+                per["rows"], per["budget_bytes"] = rows, budget
+            results[name] = per
+    finally:
+        restore_globals(snap)
+    return results
 
 
 def audit_bert_config(example_dir, *, variants=None, n_devices=None,

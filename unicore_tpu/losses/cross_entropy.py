@@ -1,4 +1,10 @@
-"""Cross-entropy loss (parity: ``unicore/losses/cross_entropy.py``)."""
+"""Cross-entropy loss (parity: ``unicore/losses/cross_entropy.py``).
+
+When the model supports the fused head contract (and ``--fused-lm-head``
+is not off), the vocab projection runs chunk-by-chunk inside the loss
+(``ops/fused_cross_entropy.py``) so the ``[B*T, V]`` logits tensor never
+materializes; the summed nll is identical math to ``compute_loss``.
+"""
 
 import math
 
@@ -7,18 +13,27 @@ import jax.numpy as jnp
 
 from unicore_tpu import metrics
 from unicore_tpu.losses import UnicoreLoss, register_loss
+from unicore_tpu.losses.unicore_loss import fused_head_request
+from unicore_tpu.ops.fused_cross_entropy import fused_head_nll
 
 
 @register_loss("cross_entropy")
 class CrossEntropyLoss(UnicoreLoss):
     def forward(self, model, params, sample, rng=None, is_training=True):
+        fused, ce_chunk = fused_head_request(self, model)
         net_output = model.apply(
             {"params": params},
             **sample["net_input"],
             deterministic=not is_training,
             rngs={"dropout": rng} if (is_training and rng is not None) else None,
+            **({"fused_head": True} if fused else {}),
         )
-        loss = self.compute_loss(net_output, sample)
+        if isinstance(net_output, dict) and "features" in net_output:
+            nll = fused_head_nll(net_output, sample["target"],
+                                 chunk_size=ce_chunk)
+            loss = jnp.sum(nll)
+        else:
+            loss = self.compute_loss(net_output, sample)
         bsz = sample["target"].shape[0]
         sample_size = jnp.asarray(bsz, dtype=jnp.float32)
         logging_output = {

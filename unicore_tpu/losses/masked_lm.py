@@ -16,6 +16,12 @@ TPU-native forms are supported, chosen by what the model returns:
   — if more than K positions are masked, the overflow is dropped from
   BOTH the numerator and the denominator, so the per-token normalization
   stays exact (VERDICT r2 weak-5).
+
+Both forms additionally have a FUSED variant (default; ``--fused-lm-head
+off`` restores the above): the model returns pre-projection features +
+the tied kernel, and ``ops/fused_cross_entropy.py`` computes the same
+nll chunk-by-chunk so the ``[rows, V]`` logits tensor never exists in
+HBM — identical loss/grads to fp32 tolerance (tests/test_fused_ce.py).
 """
 
 import math
@@ -25,6 +31,8 @@ import jax.numpy as jnp
 
 from unicore_tpu import metrics
 from unicore_tpu.losses import UnicoreLoss, register_loss
+from unicore_tpu.losses.unicore_loss import fused_head_request
+from unicore_tpu.ops.fused_cross_entropy import fused_head_nll
 
 
 @register_loss("masked_lm")
@@ -38,12 +46,14 @@ class MaskedLMLoss(UnicoreLoss):
         masked_tokens = target != self.padding_idx  # [B, T] bool, static shape
         sample_size = jnp.sum(masked_tokens.astype(jnp.float32))
 
+        fused, ce_chunk = fused_head_request(self, model)
         out = model.apply(
             {"params": params},
             **sample["net_input"],
             masked_tokens=masked_tokens,
             deterministic=not is_training,
             rngs={"dropout": rng} if (is_training and rng is not None) else None,
+            **({"fused_head": True} if fused else {}),
         )
         # nll as logsumexp - gathered logit, NOT via jax.nn.log_softmax:
         # log_softmax materializes the full fp32 log-prob tensor as its
@@ -56,7 +66,23 @@ class MaskedLMLoss(UnicoreLoss):
             picked = jnp.take_along_axis(logits32, tgt[..., None], axis=-1)
             return lse - picked[..., 0]
 
-        if isinstance(out, dict):
+        if isinstance(out, dict) and "features" in out:
+            # fused head form (features + tied kernel + bias): the vocab
+            # projection runs chunked inside the loss so the [rows, V]
+            # logits never exist — same nll math as below, per chunk
+            flat_tgt = jnp.where(masked_tokens, target, 0).reshape(-1)
+            if "slot_index" in out:
+                # static-slot head over gathered masked positions
+                tgt = flat_tgt[out["slot_index"]]  # [K]
+                nll = fused_head_nll(out, tgt, chunk_size=ce_chunk)
+                w = out["slot_valid"].astype(nll.dtype)
+            else:
+                # full-sequence head; weighted-mask loss
+                nll = fused_head_nll(out, flat_tgt, chunk_size=ce_chunk)
+                w = masked_tokens.reshape(-1).astype(nll.dtype)
+            loss = jnp.sum(nll * w)
+            sample_size = jnp.sum(w)
+        elif isinstance(out, dict):
             # static-slot head: logits [K, V] over gathered masked positions
             logits = out["logits"]
             slot_index = out["slot_index"]

@@ -21,6 +21,23 @@ function suitable for tracing inside the jitted train step::
 """
 
 
+def fused_head_request(loss, model):
+    """``(want_fused, chunk_override)`` for a loss about to call
+    ``model.apply``: the fused chunked linear+cross-entropy head
+    (``ops/fused_cross_entropy.py``) is requested when ``--fused-lm-head``
+    is not "off" (the default is on) AND the model declares
+    ``supports_fused_head`` (the features+kernel+bias output contract) —
+    models without the contract silently keep the materialized-logits
+    path.  ``chunk_override`` is ``--fused-ce-chunk`` (0/None = auto:
+    tuned verdict, else the op's byte heuristics)."""
+    args = getattr(loss, "args", None)
+    enabled = str(getattr(args, "fused_lm_head", None) or "on") != "off"
+    if not (enabled and getattr(model, "supports_fused_head", False)):
+        return False, None
+    chunk = int(getattr(args, "fused_ce_chunk", 0) or 0)
+    return True, (chunk if chunk > 0 else None)
+
+
 class UnicoreLoss:
     def __init__(self, task):
         self.task = task

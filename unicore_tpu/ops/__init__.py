@@ -17,5 +17,8 @@ from .backend import get_kernel_backend, kernel_backend, set_kernel_backend  # n
 from .layer_norm import layer_norm, layer_norm_reference  # noqa: F401
 from .softmax_dropout import softmax_dropout, softmax_dropout_reference  # noqa: F401
 from .dropout import dropout  # noqa: F401
+from .fused_cross_entropy import (  # noqa: F401
+    fused_linear_cross_entropy, linear_nll_reference,
+)
 from .rounding import fp32_to_bf16_sr, fp32_to_bf16_sr_reference  # noqa: F401
 from .multi_tensor import l2_norm  # noqa: F401

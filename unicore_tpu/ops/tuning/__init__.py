@@ -44,11 +44,40 @@ from unicore_tpu.ops.tuning.cache import (  # noqa: F401
     TuneCache, bucket_key, env_fingerprint,
 )
 from unicore_tpu.ops.tuning.candidates import (  # noqa: F401
-    OPS, PRESETS, describe_config, flash_workload, ln_workload,
+    OPS, PRESETS, ce_workload, describe_config, flash_workload, ln_workload,
     paged_workload, pow2_bucket, sd_workload,
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _static_verdict_keys():
+    """Buckets with a COMMITTED measured verdict, applied on a cache
+    miss (after the cache, before the heuristics/tuner).  Unlike cache
+    entries these are fingerprint-independent: they encode a structural
+    result, not a device timing.
+
+    The one entry today: the BENCH_r05 evoformer softmax_dropout shape
+    ([1,128,4,128,128] bf16, 5-D broadcast mask/bias) measured
+    0.985-0.994x eager across rounds — the kernel's 128x128 row blocks
+    leave only 16K elements per grid program, under the fixed-cost
+    crossover.  Recording "eager" here retires the kernel path for that
+    bucket out of the box (both dropout states); an explicit `unicore
+    tune` run on the bucket still wins, since the cache is consulted
+    first."""
+    keys = []
+    for dropout_on in (True, False):
+        wl = sd_workload(
+            (1, 128, 4, 128, 128), "bfloat16",
+            mask=((1, 128, 1, 1, 128), "bfloat16"),
+            bias=((1, 1, 4, 128, 128), "bfloat16"),
+            dropout_on=dropout_on,
+        )
+        keys.append(bucket_key(OPS["softmax_dropout"].bucket(wl)))
+    return keys
+
+
+STATIC_VERDICTS = {k: "eager" for k in _static_verdict_keys()}
 
 MODES = ("off", "cache", "tune")
 
@@ -174,6 +203,10 @@ def _decision(op_name, workload, allow_tune=False):
     decision = None
     try:
         decision = get_cache().lookup(key)
+        if decision is None:
+            # committed structural verdicts (see STATIC_VERDICTS): a
+            # measured cache entry beats them, the heuristics don't
+            decision = STATIC_VERDICTS.get(key)
         if (decision is None and allow_tune and _MODE == "tune"
                 and _can_tune_here()):
             from unicore_tpu.ops.tuning.tuner import tune_bucket
@@ -253,6 +286,31 @@ def tuned_q_blk(q, decision):
     if blk < 1 or blk > q or q % blk:
         return None
     return blk
+
+
+def fused_ce_decision(rows, hidden, vocab, dtype, tied=True, has_bias=True,
+                      allow_tune=False):
+    """Fused chunked linear+cross-entropy head (ops/fused_cross_entropy):
+    ``"eager"`` = unfused materialized logits, ``{"chunk": n}`` = fused
+    with that row chunk, None = the op's static byte heuristics."""
+    return _decision("fused_cross_entropy", ce_workload(
+        rows, hidden, vocab, dtype, tied=tied, has_bias=has_bias,
+    ), allow_tune=allow_tune)
+
+
+def tuned_ce_chunk(rows, decision):
+    """Validate a cached fused-CE config against the actual row count
+    (chunks need not divide N — the op pads — but a chunk above N is
+    just the unchunked program); None -> use the heuristic."""
+    if not isinstance(decision, dict):
+        return None
+    try:
+        chunk = int(decision["chunk"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if chunk < 1:
+        return None
+    return min(chunk, int(rows))
 
 
 def paged_decision(q_shape, table_pages, page_size, dtype,

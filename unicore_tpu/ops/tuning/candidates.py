@@ -401,6 +401,79 @@ def _paged_shrink(wl):
 
 
 # ---------------------------------------------------------------------------
+# fused chunked linear + cross-entropy head
+# ---------------------------------------------------------------------------
+
+
+def ce_workload(rows, hidden, vocab, dtype, tied=True, has_bias=True):
+    return {
+        "op": "fused_cross_entropy",
+        "rows": int(rows), "hidden": int(hidden), "vocab": int(vocab),
+        "dtype": str(dtype), "tied": bool(tied), "has_bias": bool(has_bias),
+    }
+
+
+def _ce_bucket(wl):
+    # rows/vocab pow2-bucketed (one entry covers a batch-size family);
+    # hidden exact — it picks the MXU layout of every chunk matmul
+    return ("fused_ce", wl["dtype"], pow2_bucket(wl["rows"]), wl["hidden"],
+            pow2_bucket(wl["vocab"]), int(wl["tied"]), int(wl["has_bias"]))
+
+
+def _ce_candidates(wl):
+    from unicore_tpu.ops.fused_cross_entropy import pick_chunk
+
+    chunks = [pick_chunk(wl["rows"], wl["vocab"])]
+    for c in (2048, 1024, 512, 256, 128, 64):
+        if c > wl["rows"] or c in chunks:
+            continue
+        # per-chunk fp32 logits are an HBM temporary, not VMEM — the
+        # bound only excludes configs that defeat the op's purpose
+        if c * wl["vocab"] * 4 > (128 << 20):
+            continue
+        chunks.append(c)
+    return ["eager"] + [
+        {"chunk": c} for c in chunks[:MAX_KERNEL_CANDIDATES]
+    ]
+
+
+def _ce_runner(wl, config):
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.ops.fused_cross_entropy import (
+        fused_linear_cross_entropy, linear_nll_reference,
+    )
+
+    rows, hidden, vocab = wl["rows"], wl["hidden"], wl["vocab"]
+    tied = wl["tied"]
+    f = _zeros((rows, hidden), wl["dtype"])
+    k = _zeros((vocab, hidden) if tied else (hidden, vocab), wl["dtype"])
+    bias = _zeros((vocab,), "float32") if wl["has_bias"] else None
+    t = jnp.zeros((rows,), jnp.int32)
+
+    if config == "eager":
+        def loss(f_, k_):
+            return jnp.sum(linear_nll_reference(f_, k_, t, bias=bias,
+                                                tied=tied))
+    else:
+        chunk = int(config["chunk"])
+
+        def loss(f_, k_):
+            return jnp.sum(fused_linear_cross_entropy(
+                f_, k_, t, bias=bias, tied=tied, chunk_size=chunk,
+            ))
+
+    # fwd+bwd wrt features AND weight — the training cost of the head
+    return _aot(jax.grad(loss, argnums=(0, 1)), f, k)
+
+
+def _ce_shrink(wl):
+    return dict(wl, rows=min(wl["rows"], 256), hidden=min(wl["hidden"], 64),
+                vocab=min(wl["vocab"], 512))
+
+
+# ---------------------------------------------------------------------------
 # layer_norm
 # ---------------------------------------------------------------------------
 
@@ -468,6 +541,10 @@ OPS = {
         "paged_attention", _paged_bucket, _paged_candidates, _paged_runner,
         _paged_shrink,
     ),
+    "fused_cross_entropy": OpSpec(
+        "fused_cross_entropy", _ce_bucket, _ce_candidates, _ce_runner,
+        _ce_shrink,
+    ),
 }
 
 
@@ -497,4 +574,7 @@ PRESETS = {
     "layer_norm_bert": ln_workload(16384, 768, "bfloat16"),
     # serve decode step: batch 8, 8 heads x 64, 16-token pages, 2k context
     "paged_decode_b8": paged_workload((8, 1, 8, 64), 128, 16, "bfloat16"),
+    # MLM head at the batch-64 bench shape: 8192 static slots
+    # (32768 tokens x 0.25 capacity), tied-embedding projection
+    "fused_ce_bert": ce_workload(8192, 768, 30528, "bfloat16"),
 }
