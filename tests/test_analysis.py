@@ -830,6 +830,76 @@ def test_unbounded_queue_growth_inline_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL111 blocking-in-router-loop
+# ---------------------------------------------------------------------
+
+def test_blocking_in_router_loop_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        import time
+        def dispatch_loop(replicas, worker):
+            while True:
+                for eng in replicas:
+                    eng.serve_step()
+                time.sleep(0.01)                 # pacing stall
+                worker.join()                    # parks behind one thread
+        def drive(router, home, reqs):
+            for req in reqs:
+                router.route(req)
+                home.generate([req])             # batch-blocking API
+        def nested(router, engines):
+            # fan-out in a NESTED for: the outer while still blocks
+            # once per dispatch cycle, so it classifies (UL109-style
+            # subtree semantics, not UL108's nested-loop exclusion)
+            while True:
+                for eng in engines:
+                    eng.serve_step()
+                time.sleep(1)                    # fourth offender
+    """)
+    assert sum(1 for f in found if f.rule == "UL111") == 4
+
+
+def test_blocking_in_router_loop_silent_cases(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        import time
+        def not_a_router_loop(items, worker):
+            for x in items:                      # no dispatch markers
+                time.sleep(0.01)
+                worker.join()
+        def str_join_is_fine(router, rows):
+            while True:
+                router.dispatch(rows)
+                label = ",".join(r.id for r in rows)   # one arg: str.join
+            return label
+        def paced_outside(router, reqs):
+            for req in reqs:
+                router.route(req)
+            time.sleep(0.5)                      # after the loop
+        def closure_in_loop(router, hooks):
+            while True:
+                router.serve_step()
+                def later():
+                    time.sleep(1)                # fresh scope
+                hooks.pop()
+                hooks.append(later)
+                if not hooks:
+                    break
+    """)
+    assert "UL111" not in rules_of(found)
+
+
+def test_blocking_in_router_loop_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        import time
+        def dispatch_loop(replicas):
+            while True:
+                for eng in replicas:
+                    eng.serve_step()
+                time.sleep(0.01)  # unicore-lint: disable=UL111
+    """)
+    assert "UL111" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # UL110 unguarded-dataset-io
 # ---------------------------------------------------------------------
 

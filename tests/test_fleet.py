@@ -1,0 +1,408 @@
+"""Fleet tier (unicore_tpu/fleet): consistent-hash ring properties
+(balance, minimal remap, cross-process stability), seeded trace-replay
+determinism, SLO-aware routing (overflow BEFORE a deadline blows),
+rolling-restart zero-drop, and the aggregate fleet report.
+
+The load-bearing property, inherited from the serve tier and extended
+across replicas: for ANY routing/restart trace, every request's tokens
+are IDENTICAL to decoding that request alone — affinity, overflow, and
+rolling restarts are capacity/latency features, never accuracy
+features."""
+
+import dataclasses
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from examples.lm.model import TransformerLMModel
+from unicore_tpu.fleet import (FleetRouter, HashRing, clip_trace,
+                               generate_trace, replay_trace)
+from unicore_tpu.fleet.ring import stable_hash
+from unicore_tpu.serve.engine import ServeEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V, PAD = 29, 0
+POOL = dict(num_pages=24, page_size=4, max_batch=4)
+MAX_CONTEXT = (POOL["num_pages"] - 1) * POOL["page_size"]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLMModel(
+        vocab_size=V, padding_idx=PAD, decoder_layers=2,
+        decoder_embed_dim=32, decoder_ffn_embed_dim=64,
+        decoder_attention_heads=4, max_seq_len=64,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, rel_pos=False, abs_pos=False, rotary=True,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def make_fleet(lm, n=2, router_kw=None, **engine_kw):
+    model, params = lm
+    kw = dict(POOL)
+    kw.update(engine_kw)
+    engines = {f"r{i}": ServeEngine(model, params, **kw)
+               for i in range(n)}
+    return FleetRouter(engines, **(router_kw or {}))
+
+
+def solo_tokens(lm, req):
+    """Oracle: the same request alone on a roomy solo engine."""
+    model, params = lm
+    engine = ServeEngine(model, params, num_pages=64, page_size=4,
+                         max_batch=1)
+    [res] = engine.generate([dataclasses.replace(req)])
+    return res.tokens
+
+
+# -- consistent-hash ring --------------------------------------------------
+
+
+def test_ring_balance_within_bound():
+    ring = HashRing([f"r{i}" for i in range(4)], vnodes=64)
+    counts = {rid: 0 for rid in ring.members()}
+    for k in range(2000):
+        counts[ring.lookup(f"user-{k}")] += 1
+    mean = 2000 / 4
+    assert max(counts.values()) < 2.0 * mean, counts
+    assert min(counts.values()) > 0.35 * mean, counts
+
+
+def test_ring_minimal_remap_on_leave_and_rejoin():
+    replicas = [f"r{i}" for i in range(4)]
+    ring = HashRing(replicas)
+    keys = [f"sess-{k}" for k in range(512)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("r2")
+    after = {k: ring.lookup(k) for k in keys}
+    # ONLY the departed replica's keys move, and they spread over the
+    # survivors — nobody else's mapping is disturbed
+    moved = [k for k in keys if before[k] != after[k]]
+    assert moved == [k for k in keys if before[k] == "r2"]
+    assert all(after[k] != "r2" for k in keys)
+    bound = math.ceil(len(keys) / 4) + 32  # expected n/replicas + slack
+    assert len(moved) <= bound, (len(moved), bound)
+    # rejoin restores the ORIGINAL mapping exactly
+    ring.add("r2")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_ring_stability_across_instances():
+    # affinity must survive a router restart: a FRESH ring with the
+    # same membership maps every key identically (stable_hash, not the
+    # per-process salted hash())
+    a = HashRing(["r0", "r1", "r2"])
+    b = HashRing(["r2", "r0", "r1"])  # join order must not matter
+    for k in range(200):
+        assert a.lookup(f"u{k}") == b.lookup(f"u{k}")
+    # pin one concrete digest so an accidental hash-function change
+    # (which would silently remap EVERY session) is loud
+    assert stable_hash("fixed-key") == 0xC3164720616CB4D1
+
+
+def test_ring_membership_errors():
+    ring = HashRing(["r0"])
+    with pytest.raises(ValueError):
+        ring.add("r0")
+    with pytest.raises(KeyError):
+        ring.remove("r9")
+    ring.remove("r0")
+    with pytest.raises(LookupError):
+        ring.lookup("anything")
+
+
+# -- trace generator -------------------------------------------------------
+
+
+def trace_fields(events):
+    return [(e.at_ms, e.session, e.request.prompt,
+             e.request.max_new_tokens, e.request.seed,
+             e.request.request_id) for e in events]
+
+
+def test_trace_seeded_determinism():
+    a = generate_trace(1106, num_requests=40, vocab=V)
+    b = generate_trace(1106, num_requests=40, vocab=V)
+    assert trace_fields(a) == trace_fields(b)
+    c = generate_trace(1107, num_requests=40, vocab=V)
+    assert trace_fields(a) != trace_fields(c)
+
+
+def test_trace_shape_sessions_share_prefixes():
+    events = generate_trace(3, num_requests=64, sessions=6,
+                            prefix_pool=2, vocab=V)
+    by_session = {}
+    for e in events:
+        by_session.setdefault(e.session, []).append(e.request.prompt)
+    # every request of one session opens with the SAME prefix tokens
+    prefixes = {}
+    for s, prompts in by_session.items():
+        n = min(len(p) for p in prompts)
+        shared = 0
+        while shared < n and len({tuple(p[: shared + 1])
+                                  for p in prompts}) == 1:
+            shared += 1
+        prefixes[s] = tuple(prompts[0][:4])
+        if len(prompts) > 1:
+            assert shared >= 4, (s, shared)
+    # a prefix pool of 2 over 6 sessions forces sharing ACROSS sessions
+    assert len(set(prefixes.values())) <= 2
+    # arrivals are bursty (ON/OFF): gaps span orders of magnitude
+    gaps = [b.at_ms - a.at_ms for a, b in zip(events, events[1:])]
+    assert max(gaps) > 10 * (sorted(gaps)[len(gaps) // 2] + 1e-9)
+    # prompt lengths are heavy-tailed enough to spread
+    lens = sorted(len(e.request.prompt) for e in events)
+    assert lens[-1] >= lens[0] + 8
+
+
+def test_trace_clip_drops_oversized():
+    events = generate_trace(5, num_requests=32, vocab=V,
+                            body_len_lognorm=(3.0, 1.0),
+                            body_len_clip=(1, 200))
+    kept = clip_trace(events, 64)
+    assert all(len(e.request.prompt) <= 64 for e in kept)
+    assert len(kept) < len(events)  # the clip actually engaged
+
+
+# -- engine fleet surface --------------------------------------------------
+
+
+def test_load_snapshot_is_stable_typed_dict(lm):
+    model, params = lm
+    eng = ServeEngine(model, params, max_waiting=3, **POOL)
+    snap = eng.load_snapshot()
+    want_types = {
+        "free_pages": int, "total_pages": int, "waiting": int,
+        "running": int, "free_slots": int, "max_waiting": int,
+        "draining": bool, "step_ms": float,
+    }
+    assert set(snap) == set(want_types), snap
+    for k, t in want_types.items():
+        assert isinstance(snap[k], t), (k, snap[k])
+    assert snap["free_pages"] == POOL["num_pages"] - 1
+    assert snap["free_slots"] == POOL["max_batch"]
+    assert snap["max_waiting"] == 3 and not snap["draining"]
+    eng2 = ServeEngine(model, params, **POOL)
+    assert eng2.load_snapshot()["max_waiting"] is None
+
+
+def test_submit_step_collect_matches_generate(lm):
+    model, params = lm
+    rng = np.random.RandomState(0)
+    from unicore_tpu.serve.scheduler import Request
+
+    def reqs():
+        return [Request(prompt=[int(t) for t in
+                                rng2.integers(1, V, size=(n,))],
+                        max_new_tokens=6, seed=i, request_id=f"q{i}")
+                for i, n in enumerate([3, 9, 14])]
+
+    rng2 = np.random.default_rng(0)
+    a = ServeEngine(model, params, **POOL).generate(reqs())
+    rng2 = np.random.default_rng(0)
+    eng = ServeEngine(model, params, **POOL)
+    eng.submit(reqs())
+    while eng.serve_step():
+        pass
+    b = {r.request_id: r for r in eng.collect_finished()}
+    for res in a:
+        assert b[res.request_id].tokens == res.tokens
+        assert b[res.request_id].finish_reason == res.finish_reason
+    del rng
+
+
+def test_reclaim_and_reopen(lm):
+    model, params = lm
+    from unicore_tpu.serve.scheduler import Request
+
+    eng = ServeEngine(model, params, **POOL)
+    eng.submit([Request(prompt=[1, 2, 3], max_new_tokens=4, seed=i,
+                        request_id=f"w{i}") for i in range(3)])
+    with pytest.raises(RuntimeError):
+        eng.reopen()  # busy: queued work must not be resurrected over
+    reqs = eng.reclaim_waiting()
+    assert [r.request_id for r in reqs] == ["w0", "w1", "w2"]
+    assert not eng.has_work() and eng.pool.is_idle()
+    eng.request_drain()
+    eng.serve_step()
+    eng.reopen()
+    assert not eng.load_snapshot()["draining"]
+    # the restart's drain record must not survive the reopen — a later
+    # fleet-wide drain would re-report it as ITS outcome
+    assert eng.drain_report is None
+    # a reopened engine serves again
+    [res] = eng.generate([Request(prompt=[1, 2, 3], max_new_tokens=2,
+                                  seed=0)])
+    assert res.finish_reason in ("eos", "length")
+
+
+# -- router ----------------------------------------------------------------
+
+
+def test_router_affinity_holds_without_membership_change(lm):
+    router = make_fleet(lm, n=2)
+    trace = clip_trace(
+        generate_trace(1106, num_requests=24, vocab=V,
+                       body_len_clip=(1, 20)),
+        MAX_CONTEXT,
+    )
+    replay_trace(router, trace)
+    results = router.results()
+    assert len(results) == len(trace)
+    for s, rids in router.session_replicas.items():
+        assert len(set(rids)) == 1, (s, rids)
+    # both replicas actually served (the trace spans enough sessions)
+    used = {r[0] for r in router.session_replicas.values()}
+    assert used == {"r0", "r1"}
+    assert all(e.pool.is_idle() for e in router.engines.values())
+
+
+def test_router_overflow_before_deadline(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    # service_floor 50ms: a home queue 4 deep projects 300ms of wait
+    # (x1.5 safety), past the 200ms deadline — the router must override
+    # affinity and route to the empty replica instead of queueing the
+    # request into a deterministic expiry
+    router = make_fleet(lm, n=2,
+                        router_kw=dict(service_floor_ms=50.0))
+    home = router.ring.lookup("hot")
+    other = next(r for r in router.engines if r != home)
+    filler = [Request(prompt=[1 + i, 2, 3], max_new_tokens=8, seed=i,
+                      request_id=f"f{i}") for i in range(4)]
+    for req in filler:
+        assert router.submit(req, session_key="hot") == home
+    probe = Request(prompt=[5, 6, 7], max_new_tokens=2, seed=9,
+                    request_id="probe", deadline_ms=200.0)
+    assert router.submit(probe, session_key="hot") == other
+    assert router.stats["overflow_routed"] == 1
+    # without a deadline the same pressure keeps affinity
+    tail = Request(prompt=[8, 9], max_new_tokens=2, seed=10,
+                   request_id="tail")
+    assert router.submit(tail, session_key="hot") == home
+    router.run_until_complete()
+    assert all(e.pool.is_idle() for e in router.engines.values())
+
+
+def test_router_routes_around_draining_replica(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2)
+    home = router.ring.lookup("s-drain")
+    other = next(r for r in router.engines if r != home)
+    router.engines[home].request_drain()
+    req = Request(prompt=[1, 2], max_new_tokens=2, seed=0,
+                  request_id="d0")
+    assert router.submit(req, session_key="s-drain") == other
+    router.run_until_complete()
+    assert router.results()["d0"].finish_reason in ("eos", "length")
+
+
+def test_rolling_restart_drops_nothing(lm):
+    model, params = lm
+
+    def factory(rid):
+        del rid
+        return ServeEngine(model, params, **POOL)
+
+    router = make_fleet(lm, n=2)
+    trace = clip_trace(
+        generate_trace(7, num_requests=16, vocab=V,
+                       body_len_clip=(1, 20)),
+        MAX_CONTEXT,
+    )
+    restarted = []
+
+    def hook(step, r):
+        if step == 2 and not restarted:
+            restarted.append(r.rolling_restart(factory))
+
+    replay_trace(router, trace, on_step=hook)
+    assert restarted and router.stats["restarts"] == 2
+    results = router.results()
+    assert len(results) == len(trace)
+    for ev in trace:
+        res = results[ev.request.request_id]
+        assert res.finish_reason in ("eos", "length", "capacity"), res
+        assert res.tokens == solo_tokens(lm, ev.request), res.request_id
+    for rep in restarted[0].values():
+        if rep is not None:
+            assert rep["shed"] == 0 and rep["expired"] == 0
+            assert rep["signal"] == "SIGTERM"
+    for eng in router.engines.values():
+        eng.pool.check_invariants()
+        assert eng.pool.is_idle()
+
+
+def test_fleet_report_aggregates_and_drain(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2)
+    for i in range(6):
+        router.submit(Request(prompt=[1 + i, 2, 3], max_new_tokens=4,
+                              seed=i, request_id=f"a{i}"),
+                      session_key=f"s{i % 3}")
+    router.run_until_complete()
+    rep = router.fleet_report()
+    assert rep["replicas"] == 2 and rep["sessions"] == 3
+    assert rep["router"]["routed"] == 6
+    agg = rep["aggregate"]
+    per = [router.engines[r].stats for r in router.engines]
+    assert agg["generated_tokens"] == sum(
+        s["generated_tokens"] for s in per)
+    assert agg["prefills"] == sum(s["prefills"] for s in per)
+    assert agg["peak_waiting"] == max(s["peak_waiting"] for s in per)
+    assert agg["peak_pool_occupancy"] == pytest.approx(
+        max(s["peak_pool_occupancy"] for s in per))
+    assert set(rep["per_replica"]) == {"r0", "r1"}
+    drains = router.drain()
+    assert set(drains) == {"r0", "r1"}
+    for d in drains.values():
+        assert d["requested"] and d["shed"] == 0 and d["pool_idle"]
+
+
+def test_duplicate_request_id_rejected(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2)
+    router.submit(Request(prompt=[1], max_new_tokens=1, seed=0,
+                          request_id="dup"))
+    with pytest.raises(ValueError):
+        router.submit(Request(prompt=[2], max_new_tokens=1, seed=1,
+                              request_id="dup"))
+    router.run_until_complete()
+
+
+# -- the full chaos leg (slow sibling of the fast test above) --------------
+
+
+@pytest.mark.slow
+def test_chaos_fleet_rolling_leg():
+    out = os.path.join("/tmp", "chaos_fleet_test.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "unicore_chaos.py"),
+         "--serve", "--fleet", "--rolling", "--json", out],
+        cwd=REPO, capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    import json
+
+    with open(out) as f:
+        r = json.load(f)
+    leg = r["fleet_rolling"]
+    assert leg["restarts"] == 2 and not leg["dropped"]
+    assert leg["survivors_exact"] and leg["pools_idle"]
+    assert not leg["affinity_split_sessions"]
+    assert leg["remapped_on_leave"] <= leg["remap_bound"]

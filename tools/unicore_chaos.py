@@ -49,6 +49,16 @@ applied to the continuous-batching engine):
                              bit-identical to the solo oracle (no
                              starvation under chaos preemption).
 
+Fleet-tier leg (``--serve --fleet --rolling``, ISSUE 11): a 2-replica
+in-process fleet (consistent-hash session affinity + SLO routing,
+unicore_tpu/fleet/) serves a seeded bursty replay trace while EVERY
+replica is upgraded one at a time — each drain is SIGTERM-driven
+through its ChildShutdown (the identical flag path a delivered signal
+flips).  Asserts: exit 0, ZERO admitted requests dropped (no
+failed/expired/shed finishes), every request's tokens bit-identical to
+a solo-engine oracle, session affinity held outside the restart window,
+remap bounded on membership change, and every replica pool idle.
+
 Input-pipeline legs (``--data``, ISSUE 9 — the fault ladder extended
 into the data layer, docs/fault_tolerance.md "Input pipeline"):
 
@@ -70,7 +80,8 @@ into the data layer, docs/fault_tolerance.md "Input pipeline"):
 CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
 (SIGKILL at a random step + one torn shard + bit-exact resume), the
 ``--inject nonfinite:4`` leg, the serve poison + graceful + flood legs,
-and the ``--data corrupt:2`` + ``--data hang`` legs.
+the fleet ``--serve --fleet --rolling`` leg, and the ``--data
+corrupt:2`` + ``--data hang`` legs.
 Exit code 0 iff every assertion holds.
 """
 
@@ -548,6 +559,146 @@ def serve_graceful_leg(args, report, workdir):
         )
 
 
+def serve_fleet_rolling_leg(args, report):
+    """Rolling restart of a live 2-replica fleet under seeded bursty
+    load: one replica at a time gets a SIGTERM-equivalent drain (its
+    ChildShutdown flag — the path a real signal flips) while the ring
+    reroutes its sessions.  ZERO admitted requests may drop, every
+    token stream must match the solo oracle, and both pools must end
+    idle."""
+    import math
+
+    from unicore_tpu.fleet.ring import HashRing
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import (clip_trace, generate_trace,
+                                         replay_trace)
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.engine import ServeEngine
+
+    model, params = _demo_model(args.seed)
+
+    def factory(rid):
+        del rid
+        return ServeEngine(model, params, **SERVE_POOL)
+
+    replicas = ["r0", "r1"]
+    router = FleetRouter({rid: factory(rid) for rid in replicas})
+    trace = clip_trace(
+        generate_trace(args.seed, num_requests=28,
+                       vocab=model.vocab_size, body_len_clip=(1, 20)),
+        (SERVE_POOL["num_pages"] - 1) * SERVE_POOL["page_size"],
+    )
+    sessions = sorted({e.session for e in trace})
+    print(f"[chaos] fleet rolling leg: {len(trace)} arrivals over "
+          f"{len(sessions)} sessions into {len(replicas)} replicas; "
+          f"rolling restart fires at fleet step 4", flush=True)
+
+    fired = []
+    drain_reports = {}
+
+    def hook(step, r):
+        if step == 4 and not fired:
+            fired.append(step)
+            # each replica's drain is requested with SIGTERM through
+            # its ChildShutdown — the flag path a real signal flips
+            drain_reports.update(r.rolling_restart(factory))
+
+    replay_trace(router, trace, on_step=hook)
+    if not fired:
+        raise RuntimeError("fleet rolling leg: the restart hook never "
+                           "fired — the trace finished in < 5 steps")
+    results = router.results()
+    missing = [e.request.request_id for e in trace
+               if e.request.request_id not in results]
+    dropped = [r.request_id for r in results.values()
+               if r.finish_reason not in ("eos", "length", "capacity")]
+    mismatches = []
+    for ev in trace:
+        if ev.request.request_id in missing:
+            continue  # reported below as a drop, not a KeyError here
+        want = _solo_tokens(model, params, ev.request)
+        got = results[ev.request.request_id].tokens
+        if got != want:
+            mismatches.append({"request": ev.request.request_id,
+                               "got": got, "want": want})
+    pools_idle = all(e.pool.is_idle() for e in router.engines.values())
+    for eng in router.engines.values():
+        eng.pool.check_invariants()
+
+    # affinity on an UNDISTURBED replay: same trace, fresh fleet, no
+    # restart — every session's requests must land on ONE replica
+    steady = FleetRouter({rid: factory(rid) for rid in replicas})
+    replay_trace(steady, trace)
+    affine = {s: sorted(set(r))
+              for s, r in steady.session_replicas.items()}
+    split_sessions = [s for s, r in affine.items() if len(r) > 1]
+
+    # minimal remap on membership change, on the live ring: removing
+    # one replica may move at most ~sessions/replicas (+slack) sessions
+    ring = HashRing(replicas + ["r2"])
+    before = {s: ring.lookup(s) for s in sessions}
+    ring.remove("r2")
+    after = {s: ring.lookup(s) for s in sessions}
+    remapped = [s for s in sessions if before[s] != after[s]]
+    owned_by_victim = [s for s in sessions if before[s] == "r2"]
+    remap_bound = math.ceil(len(sessions) / 3) + 2
+
+    report["fleet_rolling"] = {
+        "drains": drain_reports,
+        "arrivals": len(trace), "sessions": len(sessions),
+        "restarts": router.stats["restarts"],
+        "rerouted": router.stats["rerouted"],
+        "overflow_routed": router.stats["overflow_routed"],
+        "missing": missing, "dropped": dropped,
+        "survivors_exact": not mismatches,
+        "mismatches": mismatches[:5],
+        "pools_idle": pools_idle,
+        "affinity_split_sessions": split_sessions,
+        "remapped_on_leave": len(remapped),
+        "remap_bound": remap_bound,
+        "fleet_report": router.fleet_report(),
+    }
+    if missing or dropped:
+        raise RuntimeError(
+            f"fleet rolling leg DROPPED admitted requests: "
+            f"missing={missing} dropped={dropped}"
+        )
+    if router.stats["restarts"] != len(replicas):
+        raise RuntimeError(
+            f"fleet rolling leg: expected {len(replicas)} restarts, "
+            f"got {router.stats['restarts']}"
+        )
+    if mismatches:
+        raise RuntimeError(
+            f"fleet rolling leg: {len(mismatches)} token stream(s) "
+            f"diverged from the solo oracle: {mismatches[:3]}"
+        )
+    if not pools_idle:
+        raise RuntimeError("fleet rolling leg: pool pages leaked "
+                           "across the restart")
+    for rid, rep in drain_reports.items():
+        # a replica that happened to be idle at its turn reports None —
+        # nothing was in flight, nothing could drop
+        if rep is None:
+            continue
+        if rep["signal"] != "SIGTERM" or rep["shed"] or rep["expired"]:
+            raise RuntimeError(
+                f"fleet rolling leg: replica {rid!r} drain was not a "
+                f"clean SIGTERM-driven zero-drop drain: {rep}"
+            )
+    if split_sessions:
+        raise RuntimeError(
+            f"fleet rolling leg: sessions split across replicas on an "
+            f"undisturbed replay: {split_sessions}"
+        )
+    if set(remapped) != set(owned_by_victim) or len(remapped) > remap_bound:
+        raise RuntimeError(
+            f"fleet rolling leg: membership remap not minimal — "
+            f"remapped={remapped} victim-owned={owned_by_victim} "
+            f"bound={remap_bound}"
+        )
+
+
 def serve_main(args):
     import tempfile
 
@@ -571,10 +722,16 @@ def serve_main(args):
     if args.graceful:
         serve_graceful_leg(args, report, workdir)
         legs.append("graceful")
+    if args.fleet:
+        if not args.rolling:
+            raise SystemExit("--serve --fleet needs --rolling (the "
+                             "rolling-restart leg is the fleet leg)")
+        serve_fleet_rolling_leg(args, report)
+        legs.append("fleet-rolling")
     if not legs:
         raise SystemExit(
             "--serve needs at least one of --inject poison:K, --flood, "
-            "--graceful"
+            "--graceful, --fleet --rolling"
         )
     report["legs"] = legs
     if args.json:
@@ -931,6 +1088,14 @@ def build_parser():
                    help="(with --serve) seeded 2x-capacity overload "
                         "flood: bounded queue, deterministic sheds, no "
                         "starvation")
+    p.add_argument("--fleet", action="store_true",
+                   help="(with --serve --rolling) fleet-tier chaos: a "
+                        "2-replica router under seeded bursty load")
+    p.add_argument("--rolling", action="store_true",
+                   help="(with --serve --fleet) rolling restart: "
+                        "SIGTERM-driven one-replica-at-a-time upgrade "
+                        "drops zero admitted requests, survivors "
+                        "token-identical to the solo oracle, pools idle")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")

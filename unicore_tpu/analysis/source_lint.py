@@ -49,6 +49,19 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   ``max_waiting`` + deterministic shedding exists precisely so
   backpressure is visible to callers instead.
 
+- UL111 blocking-in-router-loop: a blocking host call inside a ROUTER
+  DISPATCH LOOP (any ``for``/``while`` whose body drives replica
+  fan-out — ``serve_step``/``route``/``dispatch``/``poll_replicas``)
+  — the fleet-tier analog of UL108/UL109.  Flagged: ``sleep`` (the
+  loop's pacing belongs to the virtual-time replay or the caller, not
+  a stall every fan-out cycle), a zero-arg ``.join()`` (a thread or
+  process join parks the router behind ONE replica while every other
+  replica's queue ages toward its deadline; ``str.join(iterable)``
+  takes an argument and is not matched), and a ``.generate(...)``
+  method call (the engine's batch-blocking run-to-completion API — one
+  replica's whole batch would serialize the fleet; routers must
+  interleave ``submit()``/``serve_step()``/``collect_finished()``).
+
 - UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
   ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
   ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
@@ -148,6 +161,12 @@ _UL109_GROW_TAILS = {"append", "appendleft", "insert"}
 # UL109: calls on the SAME collection that count as a drain/shed path
 _UL109_DRAIN_TAILS = {"pop", "popleft", "popitem", "clear", "remove"}
 
+# UL111: a loop is a ROUTER DISPATCH LOOP iff its body drives replica
+# fan-out (same subtree semantics as UL109: an outer while that fans
+# out through a nested for still blocks once per dispatch cycle)
+_ROUTER_LOOP_MARKERS = {"serve_step", "route", "dispatch",
+                        "poll_replicas"}
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -176,6 +195,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._with_seed_depth = 0
         self._step_loop_depth = 0
         self._serve_loop_depth = 0
+        self._router_loop_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
 
@@ -559,6 +579,10 @@ class _ModuleLint(ast.NodeVisitor):
         return self._loop_body_calls(loop, _SERVE_LOOP_MARKERS,
                                      skip_nested_loops=False)
 
+    def _loop_is_router_loop(self, loop):
+        return self._loop_body_calls(loop, _ROUTER_LOOP_MARKERS,
+                                     skip_nested_loops=False)
+
     def _check_unbounded_growth(self, loop):
         """UL109 over one outermost serve loop: every
         ``.append``/``.appendleft``/``.insert`` onto a named collection
@@ -632,8 +656,46 @@ class _ModuleLint(ast.NodeVisitor):
                 f"so pickling+sha256+IO overlap the next steps",
             )
 
+    def _check_blocking_in_router_loop(self, node):
+        """UL111: a blocking host call inside a router dispatch loop
+        serializes the whole fleet behind one replica."""
+        if self._router_loop_depth == 0:
+            return
+        chain = _attr_chain(node.func)
+        if chain is None:
+            return
+        tail = chain.split(".")[-1]
+        if tail == "sleep":
+            self.emit(
+                "UL111", "blocking-in-router-loop", "error", node,
+                f"'{chain}' inside a router dispatch loop — every "
+                f"fan-out cycle stalls while queued requests age "
+                f"toward their deadlines; pace the loop with the "
+                f"virtual-time trace replay (fleet/trace.py) or let "
+                f"the caller pace, never the dispatch path",
+            )
+        elif (isinstance(node.func, ast.Attribute) and tail == "join"
+                and not node.args):
+            self.emit(
+                "UL111", "blocking-in-router-loop", "error", node,
+                f"'{chain}()' inside a router dispatch loop — a "
+                f"thread/process join parks the router behind ONE "
+                f"replica while every other replica's queue ages; "
+                f"poll load_snapshot()/serve_step() cooperatively "
+                f"instead of joining",
+            )
+        elif isinstance(node.func, ast.Attribute) and tail == "generate":
+            self.emit(
+                "UL111", "blocking-in-router-loop", "error", node,
+                f"synchronous '{chain}(...)' inside a router dispatch "
+                f"loop — generate() runs one replica's whole batch to "
+                f"completion, serializing the fleet; routers must "
+                f"interleave submit()/serve_step()/collect_finished()",
+            )
+
     def _visit_loop(self, node):
         is_step = self._loop_is_step_loop(node)
+        is_router = self._loop_is_router_loop(node)
         if (self._serve_loop_depth == 0
                 and self._loop_is_serve_loop(node)):
             # scan once from the OUTERMOST serve loop: its subtree
@@ -645,9 +707,13 @@ class _ModuleLint(ast.NodeVisitor):
             is_serve = False
         if is_step:
             self._step_loop_depth += 1
+        if is_router:
+            self._router_loop_depth += 1
         self.generic_visit(node)
         if is_step:
             self._step_loop_depth -= 1
+        if is_router:
+            self._router_loop_depth -= 1
         if is_serve:
             self._serve_loop_depth -= 1
 
@@ -658,13 +724,16 @@ class _ModuleLint(ast.NodeVisitor):
         self._visit_loop(node)
 
     def _visit_scope_reset(self, node):
-        # a function/lambda DEFINED inside a step/serve loop does not
-        # run per iteration — its body is a fresh scope for UL108/UL109
+        # a function/lambda DEFINED inside a step/serve/router loop
+        # does not run per iteration — its body is a fresh scope for
+        # UL108/UL109/UL111
         saved, self._step_loop_depth = self._step_loop_depth, 0
         saved_serve, self._serve_loop_depth = self._serve_loop_depth, 0
+        saved_router, self._router_loop_depth = self._router_loop_depth, 0
         self.generic_visit(node)
         self._step_loop_depth = saved
         self._serve_loop_depth = saved_serve
+        self._router_loop_depth = saved_router
 
     def visit_FunctionDef(self, node):
         self._visit_scope_reset(node)
@@ -846,6 +915,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._check_dropout_rate(node)
         self._check_where_nan(node)
         self._check_sync_in_step_loop(node)
+        self._check_blocking_in_router_loop(node)
         self.generic_visit(node)
 
     def _visit_functions(self):

@@ -1,0 +1,29 @@
+"""``unicore_tpu.fleet`` — the serve FLEET tier (docs/serving.md#fleet):
+a replica router with consistent-hash session affinity, SLO-aware
+overflow, rolling restart, and a seeded trace-replay load generator
+over N :class:`~unicore_tpu.serve.engine.ServeEngine` replicas.
+
+Lazy init, matching ``unicore_tpu.serve``: importing the ring or the
+trace generator must not pull jitted engine machinery."""
+
+_EXPORTS = {
+    "HashRing": ("unicore_tpu.fleet.ring", "HashRing"),
+    "stable_hash": ("unicore_tpu.fleet.ring", "stable_hash"),
+    "FleetRouter": ("unicore_tpu.fleet.router", "FleetRouter"),
+    "TraceEvent": ("unicore_tpu.fleet.trace", "TraceEvent"),
+    "generate_trace": ("unicore_tpu.fleet.trace", "generate_trace"),
+    "replay_trace": ("unicore_tpu.fleet.trace", "replay_trace"),
+    "clip_trace": ("unicore_tpu.fleet.trace", "clip_trace"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)

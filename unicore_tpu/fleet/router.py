@@ -1,0 +1,348 @@
+"""FleetRouter: one router process over N ServeEngine replicas.
+
+One engine is not "millions of users" (ROADMAP north star; the
+Gemma-on-TPU serving comparison, arxiv 2605.25645, benchmarks whole
+serving stacks).  The router composes the per-engine primitives PR 7
+built — deadlines, bounded shedding, quarantine, graceful drain — into
+a fleet:
+
+- **Session affinity.**  A consistent-hash ring (:class:`~unicore_tpu.
+  fleet.ring.HashRing`) maps session keys to replicas: the same user
+  lands on the same replica run after run (the prerequisite for a
+  shared-prefix KV cache to ever hit), and membership churn remaps
+  only the departing replica's sessions.
+- **SLO-aware overflow.**  At admission the router polls every
+  replica's :meth:`~unicore_tpu.serve.engine.ServeEngine.
+  load_snapshot` and overrides affinity BEFORE a queue blows a
+  deadline: if the home replica is draining, would deterministically
+  shed, or its projected wait (queue depth x measured step time x a
+  safety factor) exceeds the request's deadline while a strictly
+  less-loaded healthy replica exists, the request overflows to the
+  least-loaded replica instead.  Affinity is a latency optimization;
+  the SLO outranks it.
+- **Rolling restart.**  :meth:`rolling_restart` upgrades the fleet one
+  replica at a time with ZERO dropped admitted requests: the victim
+  leaves the ring, its waiting requests (which hold no pool pages) are
+  reclaimed and rerouted, its drain is triggered through the SAME flag
+  path a delivered SIGTERM flips (:class:`~unicore_tpu.resilience.
+  preemption.ChildShutdown`), running work finishes while the REST of
+  the fleet keeps serving, and the replacement rejoins the ring.
+  Absolute-step-keyed sampling makes every rerouted request's tokens
+  identical to an uninterrupted run — the chaos harness's
+  ``--serve --fleet --rolling`` leg asserts it against a solo oracle.
+
+The router is single-threaded and cooperative: :meth:`step` advances
+every replica by one ``serve_step`` (never the batch-blocking
+``generate()`` — lint rule UL111 polices that shape), so the whole
+fleet is deterministic under the seeded trace replay
+(:mod:`~unicore_tpu.fleet.trace`).
+"""
+
+import logging
+import signal as _signal
+
+from unicore_tpu.resilience.preemption import ChildShutdown
+
+from .ring import HashRing
+
+logger = logging.getLogger(__name__)
+
+# stats the fleet report SUMS across replicas vs takes the MAX of —
+# the stable aggregate gauge surface (satellite: per-replica metrics
+# must roll up into ONE report, not N disjoint dicts)
+SUM_STATS = (
+    "prefills", "decode_steps", "decode_tokens", "generated_tokens",
+    "shed", "expired", "quarantined", "host_faults",
+    "capacity_failfast", "pool_exhausted_recoveries",
+)
+MAX_STATS = ("peak_waiting", "peak_pool_occupancy")
+
+
+class FleetRouter:
+    """Route requests over ``engines`` ({replica_id: ServeEngine}).
+
+    ``shutdown``: an optional fleet-level :class:`GracefulShutdown`;
+    every replica gets a :class:`ChildShutdown` wired to it, so one
+    SIGTERM drains the whole fleet while :meth:`rolling_restart`
+    targets one child at a time.  ``deadline_safety`` scales the
+    projected-wait estimate before comparing against a deadline (>1 =
+    overflow earlier).  ``service_floor_ms`` seeds the wait projection
+    before the first decode has been measured."""
+
+    def __init__(self, engines, *, vnodes=64, shutdown=None,
+                 deadline_safety=1.5, service_floor_ms=1.0):
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        self.engines = dict(engines)
+        self.ring = HashRing(self.engines, vnodes=vnodes)
+        self.shutdown = shutdown
+        self.deadline_safety = float(deadline_safety)
+        self.service_floor_ms = float(service_floor_ms)
+        self._children = {}
+        for rid, eng in self.engines.items():
+            child = self._make_child(rid)
+            eng.shutdown = child
+            self._children[rid] = child
+        self._results = {}        # request_id -> ServeResult
+        self._replica_of = {}     # request_id -> rid (current)
+        self._session_of = {}     # request_id -> session key
+        self.session_replicas = {}  # session -> [rid, ...] in route order
+        self.stats = {
+            "routed": 0, "overflow_routed": 0, "rerouted": 0,
+            "restarts": 0,
+        }
+        self._auto_id = 0
+
+    def _make_child(self, rid):
+        if self.shutdown is not None:
+            return self.shutdown.child(str(rid))
+        return ChildShutdown(name=str(rid))
+
+    # -- admission ------------------------------------------------------
+
+    def submit(self, request, session_key=None):
+        """Admit one request: pick a replica (affinity unless the SLO
+        says otherwise), enqueue it there, and record the assignment.
+        Returns the chosen replica id."""
+        if request.request_id is None:
+            request.request_id = f"fleet-r{self._auto_id}"
+            self._auto_id += 1
+        rid = request.request_id
+        if rid in self._replica_of or rid in self._results:
+            raise ValueError(f"duplicate request_id {rid!r}")
+        session = session_key if session_key is not None else rid
+        choice, reason = self._route(request, session)
+        self.engines[choice].submit([request])
+        self.stats["routed"] += 1
+        if reason != "affinity":
+            self.stats["overflow_routed"] += 1
+        self._replica_of[rid] = choice
+        self._session_of[rid] = session
+        self.session_replicas.setdefault(session, [])
+        if (not self.session_replicas[session]
+                or self.session_replicas[session][-1] != choice):
+            self.session_replicas[session].append(choice)
+        return choice
+
+    def _route(self, request, session):
+        snaps = {rid: eng.load_snapshot()
+                 for rid, eng in self.engines.items()}
+        healthy = [rid for rid in sorted(snaps)
+                   if not snaps[rid]["draining"]]
+        if not healthy:
+            # every replica draining: honor affinity and let the home
+            # replica's own shed path report the overload visibly
+            return self.ring.lookup(session), "all-draining"
+        home = self.ring.lookup(session)
+        if home not in healthy:
+            return self._least_loaded(healthy, snaps), "drain-overflow"
+        if self._would_shed(request, snaps[home]):
+            alt = self._least_loaded(healthy, snaps)
+            if alt != home:
+                return alt, "shed-overflow"
+        if self._would_blow_deadline(request, snaps[home]):
+            alt = self._least_loaded(healthy, snaps)
+            if (alt != home
+                    and self._load_key(snaps[alt], alt)
+                    < self._load_key(snaps[home], home)):
+                return alt, "slo-overflow"
+        return home, "affinity"
+
+    @staticmethod
+    def _load_key(snap, rid):
+        """Deterministic total order on load: queue depth first, then
+        pool pressure, replica id as the tiebreak."""
+        return (snap["waiting"] + snap["running"],
+                -snap["free_pages"], str(rid))
+
+    def _least_loaded(self, rids, snaps):
+        return min(rids, key=lambda r: self._load_key(snaps[r], r))
+
+    @staticmethod
+    def _would_shed(request, snap):
+        """True when the home engine's bounded queue would shed this
+        request on arrival (the engine's own add() bound: waiting >=
+        max_waiting + free decode slots) — route around a
+        deterministic shed instead of paying it."""
+        del request
+        if snap["max_waiting"] is None:
+            return False
+        return snap["waiting"] >= snap["max_waiting"] + snap["free_slots"]
+
+    def _would_blow_deadline(self, request, snap):
+        if request.deadline_ms is None:
+            return False
+        step_ms = max(snap["step_ms"], self.service_floor_ms)
+        depth = snap["waiting"] + snap["running"]
+        projected_ms = depth * step_ms * self.deadline_safety
+        return projected_ms > request.deadline_ms
+
+    # -- stepping -------------------------------------------------------
+
+    def has_work(self):
+        return any(e.has_work() for e in self.engines.values())
+
+    def step(self):
+        """One cooperative fleet step: every replica advances by one
+        ``serve_step`` (deterministic replica order).  Returns True
+        while any replica still has work."""
+        busy = False
+        for rid in sorted(self.engines):
+            if self.engines[rid].serve_step():
+                busy = True
+        return busy
+
+    def collect(self):
+        """Harvest finished results from every replica into the
+        router's result map (keyed by request_id)."""
+        for rid in sorted(self.engines):
+            for res in self.engines[rid].collect_finished():
+                self._results[res.request_id] = res
+                self._replica_of.pop(res.request_id, None)
+                self._session_of.pop(res.request_id, None)
+        return self._results
+
+    def run_until_complete(self):
+        """Drive the whole fleet to an empty queue and return the
+        result map.  (The trace replayer interleaves arrivals instead
+        — see :func:`~unicore_tpu.fleet.trace.replay_trace`.)"""
+        while self.step():
+            self.collect()
+        return self.collect()
+
+    def results(self):
+        """A view of every result harvested so far (the harness /
+        one-shot CLI surface).  A LONG-LIVED router must use
+        :meth:`take_results` instead — results carry full prompt and
+        token lists, and a map that only ever grows is the host-memory
+        shape the serve tier's bounded queues exist to prevent."""
+        return dict(self._results)
+
+    def take_results(self):
+        """Drain and return the harvested results — the long-running
+        caller's surface: once taken, the router forgets them, so its
+        memory stays flat in requests served."""
+        self.collect()
+        out, self._results = self._results, {}
+        return out
+
+    # -- rolling restart ------------------------------------------------
+
+    def rolling_restart(self, factory=None, *, signum=_signal.SIGTERM,
+                        max_steps=200000):
+        """Upgrade the fleet ONE replica at a time, dropping nothing:
+
+        for each replica (deterministic id order): leave the ring →
+        reroute its reclaimed waiting requests → request drain through
+        its ChildShutdown (``signum``, default SIGTERM — the flag path
+        a real signal flips) → step the WHOLE fleet until the victim
+        is idle (its running work finishes; everyone else keeps
+        serving) → verify its pool is idle → install ``factory(rid)``
+        (or :meth:`~ServeEngine.reopen` in place) → rejoin the ring.
+
+        Returns the per-replica drain reports."""
+        reports = {}
+        for rid in sorted(self.engines):
+            eng = self.engines[rid]
+            self.ring.remove(rid)
+            rerouted = eng.reclaim_waiting()
+            for req in rerouted:
+                # the reroute is a fresh admission elsewhere: drop the
+                # old assignment so submit() re-records it
+                self._replica_of.pop(req.request_id, None)
+                sess = self._session_of.pop(req.request_id, None)
+                self.submit(req, session_key=sess)
+                self.stats["rerouted"] += 1
+            self._children[rid].request(signum)
+            steps = 0
+            while eng.has_work():
+                # step the FLEET, not just the victim: the rerouted
+                # requests make progress while the victim drains
+                self.step()
+                self.collect()
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"replica {rid!r} did not drain within "
+                        f"{max_steps} fleet steps"
+                    )
+            eng.serve_step()  # idle call finalizes the drain report
+            reports[rid] = eng.drain_report
+            if not eng.pool.is_idle():
+                raise RuntimeError(
+                    f"replica {rid!r} drained but its pool is not idle "
+                    "— pages leaked across the restart"
+                )
+            self.collect()
+            if factory is not None:
+                new_eng = factory(rid)
+                child = self._make_child(rid)
+                new_eng.shutdown = child
+                self._children[rid] = child
+                self.engines[rid] = new_eng
+            else:
+                eng.reopen()
+            self.ring.add(rid)
+            self.stats["restarts"] += 1
+            logger.warning(
+                "rolling restart: replica %r upgraded (%d rerouted, "
+                "drain %s)", rid, len(rerouted), reports[rid],
+            )
+        return reports
+
+    # -- fleet-wide drain ----------------------------------------------
+
+    def drain(self, *, signum=None):
+        """Drain EVERY replica (the fleet process's own shutdown path)
+        and run the queues out; returns per-replica drain reports.  A
+        replica that was already idle when the drain landed gets a
+        synthesized zero report (same shape as a mid-stream drain's),
+        so the operator always sees one record per replica."""
+        for child in self._children.values():
+            child.request(signum)
+        self.run_until_complete()
+        reports = {}
+        for rid in sorted(self.engines):
+            eng = self.engines[rid]
+            eng.serve_step()  # idle call finalizes a pending report
+            rep = eng.drain_report
+            if rep is None:
+                signame = None
+                if eng.shutdown is not None and eng.shutdown.signum:
+                    signame = _signal.Signals(eng.shutdown.signum).name
+                rep = {
+                    "requested": True, "signal": signame, "drain_ms": 0.0,
+                    "drain_timeout_s": eng.drain_timeout,
+                    "shed": 0, "expired": 0, "deadline_exceeded": False,
+                    "pool_idle": eng.pool.is_idle(),
+                }
+            reports[rid] = rep
+        return reports
+
+    # -- aggregate report ----------------------------------------------
+
+    def fleet_report(self):
+        """ONE report for the whole fleet: per-replica stats rolled up
+        (sums for counters, maxes for peaks) plus the router's own
+        routing/affinity counters — the gauge surface dashboards and
+        bench.py consume."""
+        agg = {k: 0 for k in SUM_STATS}
+        agg.update({k: 0 for k in MAX_STATS})
+        for eng in self.engines.values():
+            for k in SUM_STATS:
+                agg[k] += eng.stats.get(k, 0)
+            for k in MAX_STATS:
+                agg[k] = max(agg[k], eng.stats.get(k, 0))
+        sessions = self.session_replicas
+        moved = sum(1 for rids in sessions.values() if len(set(rids)) > 1)
+        return {
+            "replicas": len(self.engines),
+            "router": dict(self.stats),
+            "sessions": len(sessions),
+            "sessions_multi_replica": moved,
+            "aggregate": agg,
+            "per_replica": {
+                str(rid): self.engines[rid].load_snapshot()
+                for rid in sorted(self.engines)
+            },
+        }

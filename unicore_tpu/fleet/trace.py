@@ -1,0 +1,160 @@
+"""Seeded trace-replay load generator for the fleet tier.
+
+Production serve traffic is not a uniform request list: arrivals are
+bursty (ON/OFF modulated Poisson), prompt lengths are heavy-tailed
+(lognormal body over a shared session prefix), and a small set of hot
+sessions dominates (Zipf).  This module generates exactly that shape as
+a pure function of ONE seed, so a flood replays bit-identically: two
+calls with the same seed produce the same arrival times, the same
+session ids, the same token streams, the same sampling seeds.  The
+chaos harness and bench.py both key on that — a "p99 under trace 1106"
+number means something only if trace 1106 is the same flood every run.
+
+Shared-prefix population: sessions draw their prefix from a small pool
+of "system prompts" (``prefix_pool``), so many sessions open with the
+SAME tokens — the workload shape that makes consistent-hash affinity
+(and ROADMAP item 1's future prefix-cache dedup) pay off.
+
+Replay is virtual-time: the fleet advances ``step_ms`` of virtual time
+per router step and events are submitted when the virtual clock reaches
+their arrival stamp.  Burst structure therefore shows up as real queue
+depth without wall-clock sleeps, and the whole replay is deterministic.
+"""
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from unicore_tpu.serve.scheduler import Request
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One arrival: ``at_ms`` is virtual time from trace start."""
+
+    at_ms: float
+    session: str
+    request: Request
+
+
+def generate_trace(seed, *, num_requests=48, sessions=8, prefix_pool=3,
+                   prefix_len=(4, 10), body_len_lognorm=(1.6, 0.8),
+                   body_len_clip=(1, 48), max_new_tokens=(4, 12),
+                   mean_iat_ms=6.0, burst_factor=8.0,
+                   mean_on_ms=40.0, mean_off_ms=120.0,
+                   zipf_a=1.3, vocab=97, temperature=0.0, top_k=0,
+                   deadline_ms=None) -> List[TraceEvent]:
+    """Deterministic bursty trace: ``num_requests`` arrivals.
+
+    - Arrivals: ON/OFF Poisson — ON phases arrive ``burst_factor``x
+      faster than the ``mean_iat_ms`` average, OFF phases are quiet;
+      phase durations are exponential (``mean_on_ms``/``mean_off_ms``).
+    - Sessions: Zipf(``zipf_a``) over ``sessions`` ids, so a few hot
+      sessions carry most requests.  Each session's prompts share that
+      session's prefix, drawn from ``prefix_pool`` system prompts.
+    - Prompt lengths: prefix + lognormal body clipped to
+      ``body_len_clip`` — heavy-tailed, bounded.
+    - Sampling seeds are derived per request from the trace seed, so a
+      replayed request is reproducible from its Request alone.
+    """
+    rng = np.random.default_rng(int(seed))
+    prefixes = [
+        [int(t) for t in rng.integers(
+            1, vocab, size=int(rng.integers(prefix_len[0],
+                                            prefix_len[1] + 1)))]
+        for _ in range(prefix_pool)
+    ]
+    session_prefix = [int(rng.integers(prefix_pool))
+                      for _ in range(sessions)]
+
+    events = []
+    t = 0.0
+    on = True
+    phase_left = float(rng.exponential(mean_on_ms))
+    # rates chosen so the long-run mean inter-arrival is ~mean_iat_ms
+    on_iat = mean_iat_ms / burst_factor
+    off_iat = mean_iat_ms * burst_factor
+    for i in range(num_requests):
+        iat = float(rng.exponential(on_iat if on else off_iat))
+        while iat >= phase_left:
+            t += phase_left
+            iat -= phase_left
+            on = not on
+            phase_left = float(rng.exponential(
+                mean_on_ms if on else mean_off_ms))
+            iat = float(rng.exponential(on_iat if on else off_iat))
+        phase_left -= iat
+        t += iat
+        s = min(int(rng.zipf(zipf_a)) - 1, sessions - 1)
+        session = f"s{s}"
+        body_n = int(np.clip(
+            round(float(rng.lognormal(*body_len_lognorm))),
+            body_len_clip[0], body_len_clip[1],
+        ))
+        body = [int(x) for x in rng.integers(1, vocab, size=body_n)]
+        req = Request(
+            prompt=list(prefixes[session_prefix[s]]) + body,
+            max_new_tokens=int(rng.integers(max_new_tokens[0],
+                                            max_new_tokens[1] + 1)),
+            temperature=float(temperature), top_k=int(top_k),
+            seed=int(stable_request_seed(seed, i)),
+            request_id=f"t{int(seed)}-{i}.{session}",
+            deadline_ms=deadline_ms,
+        )
+        events.append(TraceEvent(at_ms=round(t, 3), session=session,
+                                 request=req))
+    return events
+
+
+def stable_request_seed(trace_seed, index):
+    """Per-request sampling seed in the engine's int32 range, a pure
+    function of (trace seed, arrival index)."""
+    from .ring import stable_hash
+
+    return stable_hash(f"trace{trace_seed}/req{index}") % (2 ** 31)
+
+
+def clip_trace(events, max_context):
+    """Drop events whose prompt cannot fit ``max_context`` (tiny test
+    engines); returns the surviving events."""
+    return [e for e in events if len(e.request.prompt) <= max_context]
+
+
+def replay_trace(router, events, *, step_ms=2.0,
+                 on_step=None, max_steps=200000) -> int:
+    """Drive ``events`` through a :class:`~unicore_tpu.fleet.router.
+    FleetRouter` on a virtual clock: each fleet step advances
+    ``step_ms`` of virtual time, and events are submitted once the
+    clock reaches their stamp.  ``on_step(step_index, router)`` is the
+    mid-replay hook (the chaos harness triggers its rolling restart
+    from it).  Returns the number of fleet steps taken."""
+    pending = sorted(events, key=lambda e: (e.at_ms, e.request.request_id))
+    now = 0.0
+    steps = 0
+    i = 0
+    while i < len(pending) or router.has_work():
+        while i < len(pending) and pending[i].at_ms <= now:
+            ev = pending[i]
+            router.submit(ev.request, session_key=ev.session)
+            i += 1
+        if i < len(pending) and not router.has_work():
+            # fleet idle before the next burst: jump the virtual clock
+            now = max(now, pending[i].at_ms)
+            continue
+        router.step()
+        if on_step is not None:
+            on_step(steps, router)
+        now += step_ms
+        steps += 1
+        if steps >= max_steps:
+            raise RuntimeError(
+                f"trace replay exceeded {max_steps} fleet steps with "
+                f"{len(pending) - i} arrivals pending — wedged fleet?"
+            )
+    router.collect()
+    return steps
+
+
+__all__ = ["TraceEvent", "generate_trace", "replay_trace", "clip_trace",
+           "stable_request_seed"]

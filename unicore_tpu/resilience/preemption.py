@@ -75,6 +75,17 @@ class GracefulShutdown:
         if signum is not None:
             self.signum = signum
 
+    # -- fleet fan-out -------------------------------------------------
+
+    def child(self, name=None):
+        """A per-replica drain flag linked to this (fleet-level)
+        shutdown: the fleet router wires one child into every
+        ServeEngine replica, so ONE SIGTERM to the fleet process drains
+        every replica, while a rolling restart requests one child at a
+        time and leaves the rest serving.  Children share this
+        object's contract (``requested``/``signum``/``request``)."""
+        return ChildShutdown(parent=self, name=name)
+
     # -- handler -------------------------------------------------------
 
     def _handle(self, signum, frame):
@@ -90,3 +101,47 @@ class GracefulShutdown:
             "boundary (send SIGINT again to abort immediately)",
             signal.Signals(signum).name,
         )
+
+
+class ChildShutdown:
+    """One replica's drain flag, ORed with an optional parent
+    :class:`GracefulShutdown`.
+
+    Drain coordination for the fleet tier (docs/serving.md#fleet): a
+    replica must drain when EITHER the whole fleet was signalled (the
+    parent's SIGTERM/SIGINT handler fired) or the router singled it out
+    (rolling restart calls :meth:`request` with ``signal.SIGTERM`` —
+    the same flag path a delivered signal flips, so the engine's drain
+    machinery cannot tell the difference).  :meth:`clear` re-opens the
+    replica after its restart; a fleet-wide parent request is NOT
+    clearable from a child — a draining fleet stays draining."""
+
+    def __init__(self, parent=None, name=None):
+        self.parent = parent
+        self.name = name
+        self._requested = False
+        self._signum = None
+
+    @property
+    def requested(self):
+        return self._requested or bool(
+            self.parent is not None and self.parent.requested
+        )
+
+    @property
+    def signum(self):
+        if self._signum is not None:
+            return self._signum
+        return None if self.parent is None else self.parent.signum
+
+    def request(self, signum=None):
+        """Single this replica out for drain (rolling restart)."""
+        self._requested = True
+        if signum is not None:
+            self._signum = signum
+
+    def clear(self):
+        """Reset the CHILD's own flag (post-restart re-open).  The
+        parent's fleet-wide request, if any, still reads through."""
+        self._requested = False
+        self._signum = None

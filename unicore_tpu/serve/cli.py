@@ -16,6 +16,14 @@ Two sources of model + prompts:
 
 Output: one JSON object (``--json FILE`` or stdout) with per-request
 generated ids, finish reasons, TTFT, and the engine's aggregate stats.
+
+``--fleet`` routes the same requests through a
+:class:`~unicore_tpu.fleet.router.FleetRouter` over ``--replicas``
+in-process engines instead (consistent-hash session affinity +
+SLO-aware overflow, docs/serving.md#fleet); the report then carries
+per-replica stats and drain records plus the fleet aggregate, and the
+CI smoke asserts a clean end-of-run drain with zero leaked pages on
+every pool.
 """
 
 import argparse
@@ -56,6 +64,19 @@ def make_parser():
     eng.add_argument("--num-pages", type=int, default=64)
     eng.add_argument("--max-batch", type=int, default=8)
     eng.add_argument("--prefill-token-budget", type=int, default=512)
+    flt = p.add_argument_group("fleet (docs/serving.md#fleet)")
+    flt.add_argument("--fleet", action="store_true",
+                     help="route through a FleetRouter over --replicas "
+                          "in-process engines (consistent-hash session "
+                          "affinity + SLO-aware overflow) instead of "
+                          "one engine; the report carries per-replica "
+                          "stats, drain records, and the fleet "
+                          "aggregate")
+    flt.add_argument("--replicas", type=int, default=2,
+                     help="fleet mode: replica count (default: 2)")
+    flt.add_argument("--sessions", type=int, default=4,
+                     help="fleet mode: demo requests are spread over "
+                          "this many session keys (affinity groups)")
     rob = p.add_argument_group(
         "robustness (docs/serving.md#robustness)")
     rob.add_argument("--max-waiting", type=int, default=None,
@@ -183,6 +204,85 @@ def _file_requests(args, path):
     return reqs
 
 
+def _result_record(r):
+    return {
+        "request_id": r.request_id,
+        "prompt": r.prompt,
+        "tokens": r.tokens,
+        "finish_reason": r.finish_reason,
+        "ttft_ms": None if r.ttft_ms is None else round(r.ttft_ms, 2),
+        "evictions": r.evictions,
+    }
+
+
+def _fleet_main(args, model, params, requests, shutdown):
+    """``--fleet``: route the requests through a FleetRouter over
+    ``--replicas`` in-process engines (session keys ``s{i mod
+    --sessions}``), drive the fleet to completion, then drain every
+    replica cleanly — the report must show zero leaked pages on EVERY
+    pool and one drain record per replica (the CI smoke asserts it)."""
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.serve.engine import ServeEngine
+
+    engines = {
+        f"r{i}": ServeEngine(
+            model, params, num_pages=args.num_pages,
+            page_size=args.page_size, max_batch=args.max_batch,
+            prefill_token_budget=args.prefill_token_budget,
+            max_waiting=args.max_waiting,
+            request_retries=args.request_retries,
+            drain_timeout=args.drain_timeout,
+            step_timeout=args.step_timeout,
+            progress_path=args.progress_file,
+        )
+        for i in range(max(1, args.replicas))
+    }
+    router = FleetRouter(engines, shutdown=shutdown)
+    logger.info(
+        "fleet: %d request(s) over %d session(s) into %d replica(s) "
+        "(pool %d pages x %d slots each, max batch %d)",
+        len(requests), args.sessions, len(engines),
+        args.num_pages, args.page_size, args.max_batch,
+    )
+    for i, req in enumerate(requests):
+        router.submit(req, session_key=f"s{i % max(1, args.sessions)}")
+    router.run_until_complete()
+    # end-of-run drain: every replica closes admission and reports —
+    # on a finished workload this is a clean zero-shed drain, and it
+    # proves the pools end idle exactly like the solo path's report
+    drains = router.drain()
+    results = router.results()
+    pool_clean = all(e.pool.is_idle() for e in engines.values())
+    for eng in engines.values():
+        eng.pool.check_invariants()
+    report = {
+        "results": [_result_record(results[r.request_id])
+                    for r in requests],
+        "replicas": {
+            rid: {
+                "stats": {k: (round(v, 4) if isinstance(v, float) else v)
+                          for k, v in engines[rid].stats.items()},
+                "drain": drains[rid],
+                "pool_clean": engines[rid].pool.is_idle(),
+            }
+            for rid in sorted(engines)
+        },
+        "fleet": router.fleet_report(),
+        "sessions": {s: rids
+                     for s, rids in sorted(
+                         router.session_replicas.items())},
+        "pool_clean": pool_clean,
+    }
+    text = json.dumps(report, indent=2)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+        logger.info("wrote %s", args.json_out)
+    else:
+        print(text)
+    return 0
+
+
 def main(argv=None):
     logging.basicConfig(
         format="%(asctime)s | %(levelname)s | %(name)s | %(message)s",
@@ -222,6 +322,11 @@ def main(argv=None):
     # step boundary, in-flight work gets --drain-timeout to finish or
     # is shed, and the process still writes its report and exits 0
     shutdown = GracefulShutdown().install()
+    if args.fleet:
+        try:
+            return _fleet_main(args, model, params, requests, shutdown)
+        finally:
+            shutdown.uninstall()
     engine = ServeEngine(
         model, params, num_pages=args.num_pages, page_size=args.page_size,
         max_batch=args.max_batch,

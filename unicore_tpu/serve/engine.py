@@ -36,6 +36,16 @@ Robustness (ISSUE 7), layered on the ``resilience/`` machinery:
 - **Capacity fail-fast.**  A request whose prompt+generated prefix can
   never fit the pool terminates with reason ``"capacity"`` instead of
   cycling the preempt-retry recovery forever.
+
+Fleet-facing API (ISSUE 11): the run loop is incrementally steppable so
+a router can interleave N replicas on one thread — :meth:`submit`
+enqueues, :meth:`serve_step` advances ONE scheduler iteration,
+:meth:`collect_finished` drains results, :meth:`load_snapshot` is the
+cheap typed health/load snapshot the router polls at admission, and
+:meth:`reclaim_waiting`/:meth:`reopen` are the rolling-restart hooks
+(waiting sequences hold no pool pages, so rerouting them drops
+nothing).  :meth:`generate` is now a thin driver over the same pieces,
+so solo-engine and fleet behavior cannot diverge.
 """
 
 import contextlib
@@ -130,6 +140,13 @@ class ServeEngine:
         self.drain_report = None
         self._drain_flag = False
         self._drain_started = None
+        # incremental-stepping state (serve_step): the drain detector,
+        # its counter snapshots, and the stall watchdog live on the
+        # instance so a router can interleave this engine with others
+        self._draining = False
+        self._drain_shed0 = 0
+        self._drain_expired0 = 0
+        self._stalled = 0
         self.progress_path = progress_path
         # seeded poisoned-request injection (chaos harness): listed
         # request ids get their sampled-from logits row NaN'd INSIDE
@@ -495,13 +512,16 @@ class ServeEngine:
 
     # -- public API ----------------------------------------------------
 
-    def generate(self, requests) -> List[ServeResult]:
-        """Run a batch of :class:`Request`s to completion; results come
-        back in request order."""
+    def submit(self, requests):
+        """Validate and enqueue a batch of :class:`Request`s WITHOUT
+        driving them; returns the scheduler's Sequence handles.  The
+        fleet router's admission path — pair with :meth:`serve_step`
+        and :meth:`collect_finished`.  A bounded queue may shed some of
+        them immediately; the shed sequences come back terminal."""
         sched = self.scheduler
         # validate EVERYTHING before enqueuing anything: a mid-list
         # reject must not leave earlier requests queued as ghost work
-        # for the next generate() call
+        # for the next generate()/submit() call
         for req in requests:
             if len(req.prompt) > self.max_context:
                 raise ValueError(
@@ -535,6 +555,13 @@ class ServeEngine:
         if sched.num_shed:
             self._sync_lifecycle_stats()
             metrics.log_scalar("serve/shed", sched.num_shed)
+        return seqs
+
+    def generate(self, requests) -> List[ServeResult]:
+        """Run a batch of :class:`Request`s to completion; results come
+        back in request order."""
+        sched = self.scheduler
+        seqs = self.submit(requests)
         t0 = time.perf_counter()
         try:
             self._run_to_completion(sched)
@@ -566,18 +593,29 @@ class ServeEngine:
         out = []
         for seq in seqs:
             assert seq.done, "generate() returned with an unfinished seq"
-            out.append(ServeResult(
-                request_id=seq.req.request_id,
-                prompt=list(seq.req.prompt),
-                tokens=list(seq.generated),
-                finish_reason=seq.finish_reason,
-                ttft_ms=(
-                    None if seq.first_token_at is None
-                    else (seq.first_token_at - seq.enqueued_at) * 1e3
-                ),
-                evictions=seq.evictions,
-            ))
+            out.append(self._result_of(seq))
         return out
+
+    @staticmethod
+    def _result_of(seq):
+        return ServeResult(
+            request_id=seq.req.request_id,
+            prompt=list(seq.req.prompt),
+            tokens=list(seq.generated),
+            finish_reason=seq.finish_reason,
+            ttft_ms=(
+                None if seq.first_token_at is None
+                else (seq.first_token_at - seq.enqueued_at) * 1e3
+            ),
+            evictions=seq.evictions,
+        )
+
+    def collect_finished(self) -> List[ServeResult]:
+        """Drain every finished sequence into results (the fleet
+        router's harvest path; keeps a long-lived engine's finished
+        list from growing without bound)."""
+        done, self.scheduler.finished = self.scheduler.finished, []
+        return [self._result_of(seq) for seq in done]
 
     # -- lifecycle plumbing --------------------------------------------
 
@@ -642,138 +680,235 @@ class ServeEngine:
         metrics.log_scalar("serve/host_faults", self.stats["host_faults"])
 
     def _run_to_completion(self, sched):
-        stalled = 0
-        draining = False
-        while sched.has_work():
-            now = self._clock()
-            # deadline expiry at the ADMISSION boundary: a blown
-            # request must not take (or keep) pool pages
-            expired = bool(sched.expire(now))
-            if not draining and self._drain_requested():
-                draining = True
-                self._drain_started = now
-                # report what the DRAIN cut, not lifetime counters —
-                # pre-drain overload sheds are not the drain's doing
-                drain_shed0 = sched.num_shed
-                drain_expired0 = sched.num_expired
-                logger.warning(
-                    "drain requested: admission closed; shedding %d "
-                    "waiting request(s), %d running get %.1fs to finish",
-                    len(sched.waiting), len(sched.running),
-                    self.drain_timeout,
-                )
-            shed_now = 0
-            if draining:
-                # admission is closed: what waits now can never run
-                for seq in list(sched.waiting):
+        del sched  # serve_step reads self.scheduler
+        while self.serve_step():
+            pass
+
+    def has_work(self):
+        return self.scheduler.has_work()
+
+    def serve_step(self):
+        """Advance the engine by ONE scheduler iteration: deadline
+        expiry, drain bookkeeping, capacity fail-fast, admission +
+        prefill, one decode dispatch.  Returns True while work remains
+        queued — the fleet router's interleaving unit (and what
+        ``generate()`` loops on).  An idle call is cheap and finalizes
+        a pending drain report."""
+        sched = self.scheduler
+        if not sched.has_work():
+            self._sync_lifecycle_stats()
+            self._maybe_finalize_drain()
+            self._stalled = 0
+            return False
+        now = self._clock()
+        # deadline expiry at the ADMISSION boundary: a blown
+        # request must not take (or keep) pool pages
+        expired = bool(sched.expire(now))
+        if not self._draining and self._drain_requested():
+            self._draining = True
+            self._drain_started = now
+            # report what the DRAIN cut, not lifetime counters —
+            # pre-drain overload sheds are not the drain's doing
+            self._drain_shed0 = sched.num_shed
+            self._drain_expired0 = sched.num_expired
+            logger.warning(
+                "drain requested: admission closed; shedding %d "
+                "waiting request(s), %d running get %.1fs to finish",
+                len(sched.waiting), len(sched.running),
+                self.drain_timeout,
+            )
+        shed_now = 0
+        if self._draining:
+            # admission is closed: what waits now can never run
+            for seq in list(sched.waiting):
+                sched.finish(seq, "shed")
+                shed_now += 1
+            if (now - self._drain_started) > self.drain_timeout:
+                for seq in list(sched.running):
                     sched.finish(seq, "shed")
                     shed_now += 1
-                if (now - self._drain_started) > self.drain_timeout:
-                    for seq in list(sched.running):
-                        sched.finish(seq, "shed")
-                        shed_now += 1
-            self._sync_lifecycle_stats()
-            if not sched.has_work():
-                break
-            failed_fast = 0
-            admitted, did_decode = [], False
-            try:
-                # capacity fail-fast BEFORE admission: a head request
-                # that can never fit would otherwise stall the queue
-                while (sched.waiting
-                       and self.pool.pages_for(
-                           len(sched.waiting[0].prefix()))
-                       > self.pool.num_usable_pages):
-                    self._fail_capacity(sched.waiting[0])
-                    failed_fast += 1
-                if not draining:
-                    # admit() hands back fresh AND resumed sequences —
-                    # a resumed one re-prefills prompt+generated,
-                    # recreating exactly the KV its eviction dropped
-                    admitted = sched.admit(bucket=self.bucket_fn)
-                for seq in admitted:
-                    try:
-                        self._prefill(seq)
-                    except Exception as exc:  # host fault isolation
-                        self._host_fault([seq], "prefill", exc)
-                if not draining:
-                    sched.chaos_preempt()
-                if sched.running:
-                    todo = sched.prepare_decode()
-                    if todo:
-                        try:
-                            self._decode(todo)
-                        except Exception as exc:  # host fault isolation
-                            self._host_fault(todo, "decode", exc)
-                        did_decode = True
-                # deadline expiry at the DECODE boundary: pages free
-                # the moment the deadline blows, not a decode tail later
-                expired = bool(sched.expire(self._clock())) or expired
-            except PoolExhausted:
-                # a pathological admission race got past the
-                # can_alloc/extend guards (e.g. page accounting the
-                # scheduler didn't see move).  This is recoverable,
-                # not fatal: preempt the scheduler's LIFO victim — the
-                # same requeue-front path organic exhaustion takes, so
-                # nothing is lost and its re-prefill recreates the
-                # dropped KV — and retry the step on the freed pages.
-                if not sched.running:
-                    if sched.waiting and self.pool.is_idle():
-                        # even an EMPTY pool cannot hold the head
-                        # request: capacity, not a recoverable race
-                        self._fail_capacity(sched.waiting[0])
-                        stalled = 0
-                        continue
-                    raise  # pages missing with nothing running: a bug
-                sched.preempt(sched._pick_victim())
-                self.stats["pool_exhausted_recoveries"] += 1
-                metrics.log_scalar(
-                    "serve/pool_exhausted_recoveries",
-                    self.stats["pool_exhausted_recoveries"],
-                )
-                stalled = 0  # freed pages guarantee the retry progresses
-                continue
-            self.stats["peak_pool_occupancy"] = max(
-                self.stats["peak_pool_occupancy"], self.pool.occupancy()
-            )
-            self.stats["peak_waiting"] = max(
-                self.stats["peak_waiting"], len(sched.waiting)
-            )
-            metrics.log_scalar(
-                "serve/pool_occupancy", self.pool.occupancy()
-            )
-            # an iteration may legitimately emit nothing when its only
-            # event was an eviction (chaos, or an exhaustion cascade
-            # that drained the batch): the freed pages guarantee the
-            # NEXT iteration admits.  Two empty iterations in a row
-            # cannot happen unless the scheduler is genuinely wedged.
-            progressed = bool(admitted or did_decode or expired
-                              or failed_fast or shed_now)
-            stalled = 0 if progressed else stalled + 1
-            if stalled >= 2 and sched.has_work():
-                raise RuntimeError(
-                    "scheduler stalled with work queued — this is a bug "
-                    "(the admission guard should make progress "
-                    "inevitable)"
-                )
         self._sync_lifecycle_stats()
-        if draining:
-            drain_ms = (self._clock() - self._drain_started) * 1e3
-            signame = None
-            if (self.shutdown is not None
-                    and self.shutdown.signum is not None):
-                import signal
+        if not sched.has_work():
+            self._maybe_finalize_drain()
+            self._stalled = 0
+            return False
+        failed_fast = 0
+        admitted, did_decode = [], False
+        try:
+            # capacity fail-fast BEFORE admission: a head request
+            # that can never fit would otherwise stall the queue
+            while (sched.waiting
+                   and self.pool.pages_for(
+                       len(sched.waiting[0].prefix()))
+                   > self.pool.num_usable_pages):
+                self._fail_capacity(sched.waiting[0])
+                failed_fast += 1
+            if not self._draining:
+                # admit() hands back fresh AND resumed sequences —
+                # a resumed one re-prefills prompt+generated,
+                # recreating exactly the KV its eviction dropped
+                admitted = sched.admit(bucket=self.bucket_fn)
+            for seq in admitted:
+                try:
+                    self._prefill(seq)
+                except Exception as exc:  # host fault isolation
+                    self._host_fault([seq], "prefill", exc)
+            if not self._draining:
+                sched.chaos_preempt()
+            if sched.running:
+                todo = sched.prepare_decode()
+                if todo:
+                    try:
+                        self._decode(todo)
+                    except Exception as exc:  # host fault isolation
+                        self._host_fault(todo, "decode", exc)
+                    did_decode = True
+            # deadline expiry at the DECODE boundary: pages free
+            # the moment the deadline blows, not a decode tail later
+            expired = bool(sched.expire(self._clock())) or expired
+        except PoolExhausted:
+            # a pathological admission race got past the
+            # can_alloc/extend guards (e.g. page accounting the
+            # scheduler didn't see move).  This is recoverable,
+            # not fatal: preempt the scheduler's LIFO victim — the
+            # same requeue-front path organic exhaustion takes, so
+            # nothing is lost and its re-prefill recreates the
+            # dropped KV — and retry the step on the freed pages.
+            if not sched.running:
+                if sched.waiting and self.pool.is_idle():
+                    # even an EMPTY pool cannot hold the head
+                    # request: capacity, not a recoverable race
+                    self._fail_capacity(sched.waiting[0])
+                    self._stalled = 0
+                    return True
+                raise  # pages missing with nothing running: a bug
+            sched.preempt(sched._pick_victim())
+            self.stats["pool_exhausted_recoveries"] += 1
+            metrics.log_scalar(
+                "serve/pool_exhausted_recoveries",
+                self.stats["pool_exhausted_recoveries"],
+            )
+            self._stalled = 0  # freed pages guarantee the retry runs
+            return True
+        self.stats["peak_pool_occupancy"] = max(
+            self.stats["peak_pool_occupancy"], self.pool.occupancy()
+        )
+        self.stats["peak_waiting"] = max(
+            self.stats["peak_waiting"], len(sched.waiting)
+        )
+        metrics.log_scalar(
+            "serve/pool_occupancy", self.pool.occupancy()
+        )
+        # an iteration may legitimately emit nothing when its only
+        # event was an eviction (chaos, or an exhaustion cascade
+        # that drained the batch): the freed pages guarantee the
+        # NEXT iteration admits.  Two empty iterations in a row
+        # cannot happen unless the scheduler is genuinely wedged.
+        progressed = bool(admitted or did_decode or expired
+                          or failed_fast or shed_now)
+        self._stalled = 0 if progressed else self._stalled + 1
+        if self._stalled >= 2 and sched.has_work():
+            raise RuntimeError(
+                "scheduler stalled with work queued — this is a bug "
+                "(the admission guard should make progress "
+                "inevitable)"
+            )
+        if not sched.has_work():
+            self._sync_lifecycle_stats()
+            self._maybe_finalize_drain()
+            self._stalled = 0
+            return False
+        return True
 
-                signame = signal.Signals(self.shutdown.signum).name
-            self.drain_report = {
-                "requested": True,
-                "signal": signame,
-                "drain_ms": round(drain_ms, 2),
-                "drain_timeout_s": self.drain_timeout,
-                "shed": self.scheduler.num_shed - drain_shed0,
-                "expired": self.scheduler.num_expired - drain_expired0,
-                "deadline_exceeded": drain_ms > self.drain_timeout * 1e3,
-                "pool_idle": self.pool.is_idle(),
-            }
-            metrics.log_scalar("serve/drain_ms", drain_ms)
-            logger.warning("drain complete: %s", self.drain_report)
+    def _maybe_finalize_drain(self):
+        """Write the drain report once the queue empties while a drain
+        is active, and re-arm the detector (the flag stays set — a
+        drained engine sheds whatever a later submit enqueues, and the
+        NEXT drive re-snapshots its own counters)."""
+        if not self._draining:
+            return
+        drain_ms = (self._clock() - self._drain_started) * 1e3
+        signame = None
+        if (self.shutdown is not None
+                and self.shutdown.signum is not None):
+            import signal
+
+            signame = signal.Signals(self.shutdown.signum).name
+        self.drain_report = {
+            "requested": True,
+            "signal": signame,
+            "drain_ms": round(drain_ms, 2),
+            "drain_timeout_s": self.drain_timeout,
+            "shed": self.scheduler.num_shed - self._drain_shed0,
+            "expired": self.scheduler.num_expired - self._drain_expired0,
+            "deadline_exceeded": drain_ms > self.drain_timeout * 1e3,
+            "pool_idle": self.pool.is_idle(),
+        }
+        self._draining = False
+        metrics.log_scalar("serve/drain_ms", drain_ms)
+        logger.warning("drain complete: %s", self.drain_report)
+
+    # -- fleet-facing surface ------------------------------------------
+
+    def load_snapshot(self):
+        """Cheap router-facing load/health snapshot — a STABLE typed
+        dict (tests pin the keys and types; routers across versions
+        depend on them):
+
+        ``free_pages``/``total_pages`` (int) pool headroom,
+        ``waiting``/``running`` (int) queue depths, ``free_slots``
+        (int) open decode-batch rows, ``max_waiting`` (int or None)
+        the bounded-queue shed line, ``draining`` (bool) admission
+        closed (flag set or a wired shutdown requested), ``step_ms``
+        (float) median of the recent decode-step wall latencies (0.0
+        until the first decode) — what the router multiplies queue
+        depth by to project a request's wait against its deadline."""
+        sched = self.scheduler
+        recent = list(self.decode_ms)[-33:]
+        step_ms = float(sorted(recent)[len(recent) // 2]) if recent else 0.0
+        return {
+            "free_pages": int(self.pool.num_free_pages),
+            "total_pages": int(self.pool.num_usable_pages),
+            "waiting": int(len(sched.waiting)),
+            "running": int(len(sched.running)),
+            "free_slots": int(max(0, self.max_batch - len(sched.running))),
+            "max_waiting": (None if sched.max_waiting is None
+                            else int(sched.max_waiting)),
+            "draining": bool(self._draining or self._drain_requested()),
+            "step_ms": round(step_ms, 4),
+        }
+
+    def reclaim_waiting(self):
+        """Detach and return every WAITING request (rolling restart:
+        the router reroutes them to other replicas before this one
+        drains).  Waiting sequences hold no pool pages, so nothing
+        leaks; a reclaimed request re-runs from scratch elsewhere, and
+        absolute-step-keyed sampling makes the re-run token-identical
+        — even for a preempted sequence whose generated tokens are
+        simply regenerated."""
+        sched = self.scheduler
+        reqs = [seq.req for seq in sched.waiting]
+        sched.waiting.clear()
+        return reqs
+
+    def reopen(self):
+        """Re-open admission after a COMPLETED drain — the fleet
+        router's in-place "restart" when no replacement-engine factory
+        is given.  Refuses on a non-idle pool or queued work: reopening
+        mid-drain would resurrect exactly the half-drained state the
+        drain existed to retire."""
+        if self.scheduler.has_work() or not self.pool.is_idle():
+            raise RuntimeError(
+                "reopen() on a busy engine: drain to idle first "
+                f"(waiting={len(self.scheduler.waiting)} "
+                f"running={len(self.scheduler.running)} "
+                f"pool_idle={self.pool.is_idle()})"
+            )
+        self._drain_flag = False
+        self._draining = False
+        # the restart's drain record must not masquerade as a LATER
+        # drain's report (the router synthesizes a fresh zero report
+        # for an idle replica only when this is None)
+        self.drain_report = None
+        if self.shutdown is not None and hasattr(self.shutdown, "clear"):
+            self.shutdown.clear()  # ChildShutdown: fleet-wide reads through
