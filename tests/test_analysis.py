@@ -754,6 +754,90 @@ def test_sync_in_step_loop_inline_suppression_and_closure(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL112 sync-on-current-step
+# ---------------------------------------------------------------------
+
+def test_sync_on_current_step_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "pipeloop.py", """
+        import jax
+        def train(trainer, batches):
+            for b in batches:
+                out = trainer.train_step(b)
+                loss = out["loss"].item()        # sync on THIS step
+            return loss
+        def drive(trainer, stream):
+            staged = next(stream, None)
+            while staged is not None:
+                state, stats = trainer.train_step(staged)
+                host = jax.device_get(stats)     # current-step fetch
+                stats["gnorm"].block_until_ready()
+                staged = next(stream, None)
+    """)
+    assert sum(1 for f in found if f.rule == "UL112") == 3
+
+
+def test_sync_on_current_step_silent_on_drain_path(tmp_path):
+    found = _lint_snippet(tmp_path, "pipeloop.py", """
+        import jax
+        def train(trainer, batches):
+            # the sanctioned lag-K shape: train_step's return IS the
+            # lagged host-side stats; flush_stats gives exact counts —
+            # syncing on values from the DRAIN path must not fire
+            for b in batches:
+                out = trainer.train_step(b)
+                exact = trainer.flush_stats()
+                if exact is not None:
+                    exact[0]["loss"].item()
+            return jax.device_get(out)           # after the loop: fine
+        def rebound_from_drain(trainer, batches):
+            # rebinding the SAME name from the drain path launders it:
+            # the nearest binding above the sync is flush_stats, not
+            # the step call
+            for b in batches:
+                out = trainer.train_step(b)
+                out = trainer.flush_stats()
+                if out is not None:
+                    out[0]["loss"].item()
+            return out
+        def manual_lag_one(trainer, batches):
+            # reading the PREVIOUS iteration's output before this
+            # iteration's dispatch is the manual lag-1 idiom — the
+            # value is already on host, nothing stalls
+            prev = None
+            for b in batches:
+                if prev is not None:
+                    prev["loss"].item()
+                prev = trainer.train_step(b)
+            return prev
+        def not_a_step_loop(model, xs):
+            for x in xs:
+                y = model.valid_step(x)
+                y.block_until_ready()            # no train_step here
+    """)
+    assert "UL112" not in rules_of(found)
+
+
+def test_sync_on_current_step_suppression_and_closure(tmp_path):
+    found = _lint_snippet(tmp_path, "pipeloop.py", """
+        import jax
+        def train(trainer, batches):
+            for b in batches:
+                out = trainer.train_step(b)
+                x = jax.device_get(out)  # unicore-lint: disable=UL112,UL108
+        def builder(trainer):
+            # a closure DEFINED in the loop does not run per iteration
+            hooks = []
+            for b in ("a", "b"):
+                out = trainer.train_step(b)
+                def done():
+                    return jax.device_get(out)
+                hooks.append(done)
+            return hooks
+    """)
+    assert "UL112" not in rules_of(found)
+
+
+# ---------------------------------------------------------------------
 # UL109 unbounded-queue-growth
 # ---------------------------------------------------------------------
 

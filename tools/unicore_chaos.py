@@ -27,7 +27,14 @@ Fault-injection legs (exercising the in-loop anomaly guard end to end):
                          survived, since every later loss matches;
   --graceful             send SIGTERM instead of SIGKILL and assert the
                          run checkpointed-and-exited cleanly (exit 0)
-                         before resuming.
+                         before resuming;
+  --pipeline-depth K     run the victim with K train steps in flight
+                         (multi-step pipelined dispatch) against a
+                         strictly serial oracle (K=1, lag 0) — every
+                         leg above composes with it, proving the
+                         in-flight ring, the lag-K drain, and the
+                         rewind's discard+replay keep trajectories,
+                         checkpoints, and the ladder bit-exact.
 
 Serve-tier legs (``--serve``, ISSUE 7 — the same oracle discipline
 applied to the continuous-batching engine):
@@ -1052,6 +1059,14 @@ def build_parser():
     p.add_argument("--inject", default=None, metavar="KIND:DISPATCH",
                    help="fault injection for BOTH runs, e.g. "
                         "'nonfinite:4' (UNICORE_TPU_CHAOS_INJECT)")
+    p.add_argument("--pipeline-depth", type=int, default=1, metavar="K",
+                   help="run the CHAOS victim with K train steps in "
+                        "flight (--pipeline-depth K) while the oracle "
+                        "stays strictly serial (--pipeline-depth 1 "
+                        "--stats-lag 0): the bit-exact comparison then "
+                        "proves pipelined dispatch changes WHEN the "
+                        "host reads, never the math — including across "
+                        "kills, drains, and the anomaly ladder")
     p.add_argument("--graceful", action="store_true",
                    help="SIGTERM instead of SIGKILL: also asserts the "
                         "preemption checkpoint-and-exit path returns 0")
@@ -1132,14 +1147,23 @@ def main(argv=None):
         "fallback_used": False,
         "kill_in_write": bool(args.kill_in_write),
         "writer_fail": int(args.writer_fail),
+        "pipeline_depth": int(args.pipeline_depth),
     }
+    # pipelined legs: the ORACLE is pinned to the strict serial loop
+    # (K=1, lag 0 — the pre-pipeline semantics the ladder contract is
+    # defined against) while the victim keeps K steps in flight; the
+    # default K=1 leaves both commands exactly as before
+    oracle_extra = chaos_extra = None
+    if args.pipeline_depth > 1:
+        oracle_extra = ["--pipeline-depth", "1", "--stats-lag", "0"]
+        chaos_extra = ["--pipeline-depth", str(args.pipeline_depth)]
 
     # -- oracle ---------------------------------------------------------
     oracle_traj = os.path.join(workdir, "oracle.jsonl")
     print(f"[chaos] oracle run -> {oracle_traj}", flush=True)
     run_to_completion(
         train_cmd(args, data_dir, os.path.join(workdir, "oracle_ckpt"),
-                  oracle_traj), env,
+                  oracle_traj, extra=oracle_extra), env,
     )
     oracle = read_trajectory(oracle_traj)
     assert oracle and oracle[-1]["update"] == args.max_update, (
@@ -1149,7 +1173,8 @@ def main(argv=None):
     # -- chaos: kill / corrupt / resume cycles --------------------------
     chaos_traj = os.path.join(workdir, "chaos.jsonl")
     save_dir = os.path.join(workdir, "chaos_ckpt")
-    cmd = train_cmd(args, data_dir, save_dir, chaos_traj)
+    cmd = train_cmd(args, data_dir, save_dir, chaos_traj,
+                    extra=chaos_extra)
     for cycle in range(args.kills):
         if args.writer_fail:
             # writer-IO-failure leg: no kill — the injected failure must

@@ -62,6 +62,21 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   replica's whole batch would serialize the fleet; routers must
   interleave ``submit()``/``serve_step()``/``collect_finished()``).
 
+- UL112 sync-on-current-step: a blocking host sync — ``jax.device_get``,
+  ``.item()``, or ``.block_until_ready()`` — applied to a value bound
+  from the ``train_step`` call of the SAME loop iteration.  This is the
+  pattern that silently collapses a pipelined train loop
+  (``--pipeline-depth K >= 2``): the current step's outputs cannot be
+  ready yet, so the sync stalls the host a full device step and the
+  in-flight ring never fills.  The lag-K drain path is the sanctioned
+  read — ``train_step``'s return value is already host-side lagged
+  stats, and ``flush_stats()`` at real boundaries gives exact counts;
+  syncing on THOSE does not fire (the rule tracks data flow from the
+  step call, not the loop alone — that coarser check is UL108), and a
+  sync placed textually BEFORE the binding reads the previous
+  iteration's already-on-host value (the manual lag-1 idiom) and is
+  silent too.
+
 - UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
   ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
   ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
@@ -166,6 +181,11 @@ _UL109_DRAIN_TAILS = {"pop", "popleft", "popitem", "clear", "remove"}
 # out through a nested for still blocks once per dispatch cycle)
 _ROUTER_LOOP_MARKERS = {"serve_step", "route", "dispatch",
                         "poll_replicas"}
+
+# UL112: method-tail syncs on a value bound from the step call this
+# iteration (device_get is matched by chain, it takes the value as an
+# argument instead)
+_UL112_METHOD_TAILS = {"item", "block_until_ready"}
 
 
 def _attr_chain(node):
@@ -656,6 +676,88 @@ class _ModuleLint(ast.NodeVisitor):
                 f"so pickling+sha256+IO overlap the next steps",
             )
 
+    def _check_sync_on_current_step(self, loop):
+        """UL112 over one outermost step loop: collect the names bound
+        from ``train_step`` calls anywhere in the loop subtree (tuple
+        targets included), then flag every blocking sync whose operand
+        data-flows from one of them — ``jax.device_get(<name>...)``,
+        ``<name>....item()``, ``<name>....block_until_ready()``.  Values
+        from the drain path (``flush_stats`` returns, lagged stats) are
+        not step-call bindings and never fire.  Closures defined in the
+        loop are fresh scopes, as everywhere in this linter."""
+        step_binds = {}   # name -> linenos bound FROM train_step
+        other_binds = {}  # name -> linenos bound from anything else
+        syncs = []
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Assign):
+                is_step = (
+                    isinstance(sub.value, ast.Call)
+                    and (chain := _attr_chain(sub.value.func)) is not None
+                    and chain.split(".")[-1] in _STEP_LOOP_MARKERS
+                )
+                table = step_binds if is_step else other_binds
+                for tgt in sub.targets:
+                    elts = (tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt])
+                    for el in elts:
+                        if isinstance(el, ast.Name):
+                            table.setdefault(el.id, []).append(sub.lineno)
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if (chain is not None
+                        and chain.split(".")[-1] == "device_get"
+                        and sub.args):
+                    syncs.append(
+                        (sub, chain, self._value_names(sub.args[0]))
+                    )
+                elif (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _UL112_METHOD_TAILS
+                        and not sub.args):
+                    syncs.append((
+                        sub, sub.func.attr,
+                        self._value_names(sub.func.value),
+                    ))
+            stack.extend(ast.iter_child_nodes(sub))
+        if not step_binds:
+            return
+
+        def current_step_value(root, sync_line):
+            """Statement order is the lag discriminator: the sync fires
+            only when the NEAREST binding of ``root`` above it is a
+            train_step bind.  A sync before any step bind reads the
+            previous iteration's (already-on-host, lag-1) value — the
+            sanctioned manual lag idiom — and a rebind from anything
+            else in between (e.g. ``out = trainer.flush_stats()``)
+            launders the name back to the drain path."""
+            step = max((x for x in step_binds.get(root, [])
+                        if x < sync_line), default=None)
+            if step is None:
+                return False
+            rebind = max((x for x in other_binds.get(root, [])
+                          if x < sync_line), default=None)
+            return rebind is None or rebind < step
+
+        for node, what, names in syncs:
+            roots = {n.split(".")[0] for n in names} & set(step_binds)
+            if not any(current_step_value(r, node.lineno) for r in roots):
+                continue
+            self.emit(
+                "UL112", "sync-on-current-step", "error", node,
+                f"blocking sync '{what}' on the CURRENT step's outputs "
+                f"inside the train loop — the value was bound from "
+                f"train_step this very iteration, so the host stalls a "
+                f"full device step and a pipelined loop "
+                f"(--pipeline-depth >= 2) silently collapses to serial "
+                f"dispatch; read the lag-K drained outputs train_step "
+                f"already returns (or flush_stats() at real boundaries) "
+                f"instead",
+            )
+
     def _check_blocking_in_router_loop(self, node):
         """UL111: a blocking host call inside a router dispatch loop
         serializes the whole fleet behind one replica."""
@@ -706,6 +808,11 @@ class _ModuleLint(ast.NodeVisitor):
         else:
             is_serve = False
         if is_step:
+            if self._step_loop_depth == 0:
+                # scan once from the OUTERMOST step loop (UL109 pattern):
+                # its subtree covers nested loops' step bindings and
+                # sync sites alike
+                self._check_sync_on_current_step(node)
             self._step_loop_depth += 1
         if is_router:
             self._router_loop_depth += 1

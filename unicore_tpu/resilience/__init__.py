@@ -29,6 +29,7 @@ package holds the run-time machinery.
 """
 
 from .anomaly import (  # noqa: F401
+    GUARD_CARRY_KEYS,
     AnomalyGuardConfig,
     EscalationPolicy,
     guard_init,

@@ -29,6 +29,16 @@ import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
 
+# The ladder counters that survive a rewind: restoring the snapshot's
+# own (clean, streak-0) values would let a persistent fault loop
+# skip->rewind forever with the abort rung unreachable.  With pipelined
+# dispatch (--pipeline-depth K >= 2) detection lags dispatch by up to
+# K-1 steps, and the live head guard already includes the in-flight
+# dispatches issued PAST the anomaly — so the trainer carries these
+# keys from the ANOMALOUS step's own drained stats instead of the head
+# (serial and pipelined runs then walk the identical ladder).
+GUARD_CARRY_KEYS = ("streak", "skips", "spikes")
+
 
 @dataclass(frozen=True)
 class AnomalyGuardConfig:

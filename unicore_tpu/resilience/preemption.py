@@ -7,7 +7,12 @@ checkpoint re-pays every update since the last save interval.  The
 handler only SETS A FLAG — all real work (flush lagged stats, write the
 checkpoint, close worker pools) happens at the next step boundary on
 the main thread, because signal handlers must not touch the jax runtime
-mid-dispatch.
+mid-dispatch.  Under pipelined dispatch (``--pipeline-depth K >= 2``)
+the boundary flush first drains every in-flight dispatch, so the
+preemption checkpoint carries exact counts and an iterator position
+that counts only dispatched groups — a staged-but-undispatched batch
+can never enter it (the chaos harness's pipelined SIGTERM leg proves
+the resume bit-exact).
 
 A second SIGINT restores the default handler and re-raises, so an
 operator can still hard-kill a wedged run from the keyboard."""

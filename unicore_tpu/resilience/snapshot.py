@@ -10,7 +10,16 @@ per-device shards (``(device, np-copy)`` pairs) and restored with
 sharding — the same no-global-assembly discipline the sharded
 checkpoint path follows, so the ring works identically on a pure-DP
 single host and an fsdp/tp multi-host mesh (every host rewinds its own
-shards in lockstep)."""
+shards in lockstep).
+
+Pipelined dispatch (``--pipeline-depth K >= 2``): captures stay exact —
+the trainer flushes its in-flight ring around every snapshot-interval
+crossing and takes the capture with NOTHING newer in flight, so a ring
+entry is always the state after exactly its recorded update, identical
+to a serial run's.  A rewind with K steps in flight discards the
+dispatches issued past the anomaly and replays their held staged
+batches; effective rewind depth therefore grows to K dispatches, which
+the ring (>= 2 entries by default) already covers."""
 
 import collections
 import logging

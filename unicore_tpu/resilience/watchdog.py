@@ -75,7 +75,13 @@ class StepWatchdog:
             self.dog._disarm()
             return False
 
-    def armed(self, phase):
+    def armed(self, phase, detail=None):
+        """``detail`` (optional) is appended to the phase string at arm
+        time — the pipelined trainer passes its in-flight depth so a
+        timeout dump names how many dispatched steps sat behind the
+        hung drain."""
+        if detail:
+            phase = f"{phase} [{detail}]"
         return self._Armed(self, phase)
 
     def _arm(self, phase):

@@ -266,8 +266,42 @@ class Trainer:
         # step N (on a remote/relayed chip the per-step blocking fetch was
         # costing ~40% of wall time); 0 restores strict per-step sync
         self.stats_lag = max(0, int(getattr(args, "stats_lag", 0) or 0))
+        # multi-step pipelined dispatch (--pipeline-depth K): keep up to K
+        # dispatched steps in flight before the host blocks on the oldest
+        # one's outputs.  K=1 keeps the classic loop (the --stats-lag
+        # drain discipline below, byte-identical trajectories); K>=2
+        # subsumes --stats-lag: the in-flight ring drains OPPORTUNISTICALLY
+        # (only outputs already on host) and blocks only to free a slot, so
+        # the device always holds a queued step while the host does its
+        # boundary bookkeeping (docs/performance.md#pipelined-dispatch)
+        self.pipeline_depth = max(
+            1, int(getattr(args, "pipeline_depth", 1) or 1)
+        )
+        # in-flight ring entries: (stats, weights_np, first_sample,
+        # dispatch_idx, staged-or-None).  The staged batch is held only at
+        # K>=2 — the rewind ladder re-dispatches it with the SAME dispatch
+        # id after discarding results computed past a detected anomaly.
         self._pending_stats: List[Any] = []
+        # staged batches queued for (re-)dispatch; non-empty only
+        # transiently inside a pipelined train_step call (every pulled
+        # batch is dispatched before the call returns, so a preemption
+        # checkpoint's iterator position never counts a staged-but-
+        # undispatched group)
+        self._replay_queue: List[Any] = []
+        # total processed (drained) steps — the train loop keys its
+        # boundary checks (writer poll, data health) on this advancing so
+        # they ride the drain point at K>=2 instead of the dispatch path
+        self.retired_steps = 0
         self._dispatch_count: Optional[int] = None
+        self._base_rng = None  # PRNGKey(seed), built once at first dispatch
+        # per-dispatch folded keys, precomputed in blocks: one bulk
+        # vmapped fold_in every _RNG_BLOCK dispatches instead of an
+        # eager fold op on every boundary (measured ~1.2 ms/step under
+        # dispatch contention); rows are host numpy, bit-identical to
+        # the eager fold (self-checked once, fail-open to eager)
+        self._rng_block = None
+        self._fold_block_fn = None
+        self._fold_block_ok = None
         self._valid_batch_idx = 0
         # step-boundary host-time accounting (bench step_boundary_host_ms):
         # wall time from one compiled call's return to the next one's
@@ -280,8 +314,17 @@ class Trainer:
                             # stalls from device step time for bench's
                             # input_stall_ms
                             "input_wait_s": 0.0,
-                            "input_waits": 0}
+                            "input_waits": 0,
+                            # K>=2: host time blocked on a lag-K stats
+                            # fetch — device-bound wait, not host work, so
+                            # it is excluded from step_boundary_host_s
+                            "drain_wait_s": 0.0,
+                            "drain_waits": 0}
         self._boundary_started = None
+        # K>=2: seconds of the current boundary window spent blocked on
+        # device outputs (stats drain, snapshot capture) — subtracted
+        # from the window so step_boundary_host_ms measures HOST work
+        self._boundary_excluded_s = 0.0
         # background checkpoint writer (attached by the CLI from the
         # CheckpointManager): consulted by the rewind interlock and the
         # watchdog's timeout context
@@ -946,12 +989,26 @@ class Trainer:
         the step dispatched ``stats_lag`` calls ago (None while the
         pipeline fills); callers that need exact counts/meters (stop
         checks, checkpoint, validation) call :meth:`flush_stats` first.
+        At ``--pipeline-depth K >= 2`` the in-flight ring replaces the
+        stats-lag drain: see :meth:`_pipelined_step`.
         """
         self._set_seed_noop()
         staged = self.stage_batches(samples)
         if self.state is None:
             self.init_state(staged.first_sample)
+        if self.pipeline_depth > 1:
+            return self._pipelined_step(staged)
+        self._dispatch_staged(staged)
+        out = None
+        while len(self._pending_stats) > self.stats_lag:
+            out = self._pop_process()
+        return out
 
+    def _dispatch_staged(self, staged, hold_batch=False):
+        """Dispatch one staged micro-batch group through the compiled
+        step and append its (still-on-device) stats to the in-flight
+        ring.  ``hold_batch`` keeps the :class:`StagedBatch` on the ring
+        entry (K>=2: the rewind ladder re-dispatches it)."""
         batches, weights_np = staged.batches, staged.weights_np
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
@@ -967,7 +1024,10 @@ class Trainer:
         # N-1" — step_update is a pure function of the count for every
         # scheduler, so re-invoking it here is side-effect-safe (the
         # metrics lr gauge is still logged at processing time)
-        lr = jnp.float32(
+        # np scalar, not jnp: the compiled call converts it on its own
+        # fast path, where an eager jnp.float32 would pay a full op
+        # dispatch per step on the boundary critical path
+        lr = np.float32(
             self.lr_scheduler.step_update(
                 self.get_num_updates() + len(self._pending_stats)
             )
@@ -976,25 +1036,37 @@ class Trainer:
         # the update count is stale at dispatch time, and two steps must
         # never draw the same dropout stream (the reference's per-update
         # torch_seed scoping, trainer.py:610-616)
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.seed), self._dispatch_count
-        )
+        rng = self._folded_key(self._dispatch_count)
         dispatch_idx = self._dispatch_count
         self._dispatch_count += 1
-        inject = jnp.float32(
+        inject = np.float32(
             1.0 if (self._chaos_inject is not None
                     and dispatch_idx == self._chaos_inject[1]) else 0.0
         )
         if self._boundary_started is not None:
-            self.host_timers["step_boundary_host_s"] += (
-                time.perf_counter() - self._boundary_started
-            )
+            elapsed = time.perf_counter() - self._boundary_started
+            if self.pipeline_depth > 1:
+                # the window's device-bound waits (lag-K drain, snapshot
+                # capture) are not host work — the host was idle while
+                # the device chewed its queued steps
+                elapsed = max(0.0, elapsed - self._boundary_excluded_s)
+                if (self._pending_stats and not self._stats_ready(
+                        self._pending_stats[-1][0])):
+                    # the newest in-flight step is STILL executing: the
+                    # device never idled under this window, so none of
+                    # its host work is step-boundary exposure — this is
+                    # exactly the overlap the pipeline exists to buy
+                    elapsed = 0.0
+            self._boundary_excluded_s = 0.0
+            self.host_timers["step_boundary_host_s"] += elapsed
             self.host_timers["step_boundaries"] += 1
         try:
             with jax.profiler.TraceAnnotation("train_step/dispatch"):
+                # weights ride as the host numpy array: the compiled
+                # call's own argument conversion is cheaper than an
+                # eager device transfer on the boundary critical path
                 self.state, stats = self._dispatch_train_step(
-                    self.state, batches, jnp.asarray(weights_np), lr,
-                    rng, inject,
+                    self.state, batches, weights_np, lr, rng, inject,
                 )
         except Exception as e:
             # the reference logs cuda memory_summary on step failure
@@ -1018,11 +1090,122 @@ class Trainer:
                 )
 
         self._pending_stats.append(
-            (stats, weights_np, staged.first_sample, dispatch_idx)
+            (stats, weights_np, staged.first_sample, dispatch_idx,
+             staged if hold_batch else None)
         )
+
+    def _pop_process(self):
+        """Drain the oldest in-flight entry through
+        :meth:`_process_stats` (blocking if its outputs are not yet on
+        host)."""
+        entry = self._pending_stats.pop(0)
+        return self._process_stats(entry[0], entry[1], entry[2], entry[3])
+
+    _RNG_BLOCK = 64
+
+    def _folded_key(self, idx):
+        """``fold_in(PRNGKey(seed), idx)`` — served from a precomputed
+        block of ``_RNG_BLOCK`` keys (one bulk vmapped fold per block,
+        fetched to host numpy) so the per-dispatch boundary pays an
+        array index instead of an eager op.  The first block is
+        self-checked bitwise against the eager fold and the whole
+        optimization fails open to eager folding on any mismatch —
+        dropout streams are part of the bit-exact chaos contract."""
+        if self._base_rng is None:
+            self._base_rng = jax.random.PRNGKey(self.seed)
+        if self._fold_block_ok is False:
+            return jax.random.fold_in(self._base_rng, idx)
+        blk, off = divmod(int(idx), self._RNG_BLOCK)
+        if self._rng_block is None or self._rng_block[0] != blk:
+            if self._fold_block_fn is None:
+                base = self._base_rng
+                n = self._RNG_BLOCK
+
+                def fold_block(start):
+                    return jax.vmap(
+                        lambda i: jax.random.fold_in(base, i)
+                    )(start + jnp.arange(n, dtype=jnp.int32))
+
+                self._fold_block_fn = jax.jit(fold_block)
+            keys = np.asarray(jax.device_get(
+                self._fold_block_fn(np.int32(blk * self._RNG_BLOCK))
+            ))
+            if self._fold_block_ok is None:
+                eager = np.asarray(jax.device_get(
+                    jax.random.fold_in(self._base_rng, idx)
+                ))
+                self._fold_block_ok = np.array_equal(keys[off], eager)
+                if not self._fold_block_ok:
+                    logger.warning(
+                        "bulk-folded rng keys diverge from the eager "
+                        "fold on this backend; falling back to eager "
+                        "per-dispatch folding"
+                    )
+                    self._rng_block = None
+                    return jax.random.fold_in(self._base_rng, idx)
+            self._rng_block = (blk, keys)
+        return self._rng_block[1][off]
+
+    @staticmethod
+    def _stats_ready(stats):
+        """True when a step's stats are already on host — all leaves of
+        one compiled call complete together, so one probe suffices."""
+        leaf = stats["sample_size"]
+        probe = getattr(leaf, "is_ready", None)
+        return bool(probe()) if probe is not None else True
+
+    def _snapshot_window_hit(self):
+        """K>=2: does a snapshot interval crossing fall inside the
+        in-flight uncertainty window [updates+1, updates+pending+1]?
+        The optimistic update count cannot tell WHICH dispatch will land
+        on the interval (an in-flight anomaly shifts it), so the
+        pipelined loop flushes to exact counts near every crossing and
+        takes the snapshot in sync mode — the captured state is
+        bit-identical to the serial loop's (post the exact interval
+        update, nothing newer in flight)."""
+        if self._snapshot_ring is None:
+            return False
+        iv = self._snapshot_interval
+        lo = self.get_num_updates() + 1
+        hi = lo + len(self._pending_stats)
+        return (hi // iv) > ((lo - 1) // iv)
+
+    def _pipelined_step(self, staged):
+        """K>=2 drain discipline: dispatch first, then only touch
+        outputs that are already on host; block solely to free an
+        in-flight slot (a device-bound wait, excluded from the boundary
+        host-time accounting) or to keep a snapshot capture exact.  The
+        replay queue is consumed to empty before returning, so a rewind
+        inside any drain re-dispatches its discarded batches — same
+        staged buffers, same dispatch ids — within this call."""
+        queue = self._replay_queue
+        queue.append(staged)
         out = None
-        while len(self._pending_stats) > self.stats_lag:
-            out = self._process_stats(*self._pending_stats.pop(0))
+        while queue:
+            # free a slot: block on the oldest step (its watchdog-armed
+            # device_get is the drain point; the device still holds the
+            # other K-1 queued steps, so this wait cannot starve it)
+            while len(self._pending_stats) >= self.pipeline_depth:
+                got = self._pop_process()
+                out = got if got is not None else out
+            sync_snapshot = False
+            if self._snapshot_window_hit():
+                got = self._drain_all()
+                out = got if got is not None else out
+                iv = self._snapshot_interval
+                sync_snapshot = (self.get_num_updates() + 1) % iv == 0
+            self._dispatch_staged(queue.pop(0), hold_batch=True)
+            if sync_snapshot:
+                # drain this dispatch immediately: _maybe_snapshot then
+                # captures exactly the post-interval-update state (one
+                # pipeline bubble per snapshot interval)
+                got = self._drain_all()
+                out = got if got is not None else out
+            else:
+                while (self._pending_stats
+                       and self._stats_ready(self._pending_stats[0][0])):
+                    got = self._pop_process()
+                    out = got if got is not None else out
         return out
 
     def trace_train_step(self, samples):
@@ -1081,6 +1264,14 @@ class Trainer:
         # compile legitimately takes minutes — arming it too would
         # exit-87 a healthy run into a supervisor crash loop that hits
         # the identical compile on every restart
+        if self.pipeline_depth > 1:
+            # K>=2: the call returns as soon as the step is queued
+            # (async dispatch), so a hung device surfaces at the armed
+            # lag-K stats drain instead — the per-dispatch arm/disarm
+            # pair would be pure boundary overhead here
+            return self._compiled_train_step(
+                state, batches, weights, lr, rng, inject
+            )
         with self._watchdog.armed("train_step/dispatch"):
             return self._compiled_train_step(
                 state, batches, weights, lr, rng, inject
@@ -1160,10 +1351,32 @@ class Trainer:
         ))
 
     def flush_stats(self):
-        """Drain pending lagged stats so num_updates/meters are exact."""
+        """Drain pending lagged stats so num_updates/meters are exact.
+
+        At K>=2 a rewind processed DURING this flush re-queues the
+        discarded in-flight batches — they are re-dispatched and
+        drained here too, so a flush point (checkpoint, preemption,
+        validation, epoch boundary) always leaves every pulled group
+        dispatched and processed: the checkpoint's dispatch_count and
+        the iterator position stay aligned."""
+        out = None
+        while self._pending_stats or self._replay_queue:
+            if not self._pending_stats:
+                self._dispatch_staged(self._replay_queue.pop(0),
+                                      hold_batch=True)
+                continue
+            got = self._pop_process()
+            out = got if got is not None else out
+        return out
+
+    def _drain_all(self):
+        """Process every in-flight ring entry, oldest first; rewind
+        replays spawned mid-drain ride ``_replay_queue`` for the
+        caller.  Returns the last processed step's logging outputs."""
         out = None
         while self._pending_stats:
-            out = self._process_stats(*self._pending_stats.pop(0))
+            got = self._pop_process()
+            out = got if got is not None else out
         return out
 
     def num_pending_updates(self):
@@ -1174,9 +1387,32 @@ class Trainer:
     def _process_stats(self, stats, weights_np, first_sample,
                        dispatch_idx=None):
         # host-side bookkeeping (one device->host sync per processed step)
+        pipelined = self.pipeline_depth > 1
+        detail = (
+            f"in_flight={len(self._pending_stats) + 1}"
+            f"/{self.pipeline_depth}" if pipelined else None
+        )
         with jax.profiler.TraceAnnotation("train_step/stats-sync"):
-            with self._watchdog.armed("train_step/stats-sync"):
-                stats = jax.device_get(stats)
+            with self._watchdog.armed("train_step/stats-sync",
+                                      detail=detail):
+                t0 = time.perf_counter() if pipelined else None
+                try:
+                    stats = jax.device_get(stats)
+                except Exception as e:
+                    # with lagged/pipelined dispatch a failed step
+                    # surfaces HERE, not at the (async) dispatch call —
+                    # give the operator the same HBM breakdown and OOM
+                    # knobs the serial path guarantees
+                    self.log_memory_stats(level=logging.ERROR)
+                    if _looks_like_oom(e):
+                        logger.error(self._oom_guidance())
+                    raise
+                if t0 is not None:
+                    waited = time.perf_counter() - t0
+                    self._boundary_excluded_s += waited
+                    self.host_timers["drain_wait_s"] += waited
+                    self.host_timers["drain_waits"] += 1
+        self.retired_steps += 1
         overflow = bool(stats["overflow"] > 0)
         anom = stats["anomaly"]
         anomalous = bool(anom["anomalous"] > 0)
@@ -1245,7 +1481,17 @@ class Trainer:
                 metrics.log_scalar("loss_spikes", 1, priority=620, round=0)
             self._record_trajectory(stats, dispatch_idx, action)
             if action == "rewind":
-                self._rewind_to_snapshot()
+                # K>=2: the head state includes in-flight dispatches
+                # issued PAST this anomaly — carry the ladder counters
+                # from this step's own (already-fetched) guard scalars,
+                # exactly what a serial run's live guard would hold
+                from unicore_tpu.resilience import GUARD_CARRY_KEYS
+
+                carry = (
+                    {k: anom[k] for k in GUARD_CARRY_KEYS}
+                    if self.pipeline_depth > 1 else None
+                )
+                self._rewind_to_snapshot(guard_carry=carry)
         else:
             self.set_num_updates(self.get_num_updates() + 1)
             self._record_trajectory(stats, dispatch_idx, "none")
@@ -1286,6 +1532,14 @@ class Trainer:
 
     def _watchdog_context(self):
         parts = []
+        if self.pipeline_depth > 1:
+            # a timeout dump must name how deep the dispatch pipeline
+            # was — K-1 queued steps behind a hung drain read very
+            # differently from an empty ring behind a hung dispatch
+            parts.append(
+                f"pipeline in_flight={len(self._pending_stats)}"
+                f"/{self.pipeline_depth}"
+            )
         if self._ckpt_writer is not None:
             parts.append(str(self._ckpt_writer.status()))
         if self._input_status is not None:
@@ -1320,29 +1574,43 @@ class Trainer:
             return
         updates = self.get_num_updates()
         if updates > 0 and updates % self._snapshot_interval == 0:
+            t0 = time.perf_counter() if self.pipeline_depth > 1 else None
             with jax.profiler.TraceAnnotation("train_step/snapshot"):
                 self._snapshot_ring.take(
                     self.state, updates, self._dispatch_count or 0
                 )
+            if t0 is not None:
+                # the capture blocks on the step's completion
+                # (device-bound) — keep it out of the boundary host time
+                self._boundary_excluded_s += time.perf_counter() - t0
             logger.info(
                 "anomaly guard: took last-good snapshot @ %d updates "
                 "(ring holds %d)", updates, len(self._snapshot_ring),
             )
 
-    def _rewind_to_snapshot(self):
+    def _rewind_to_snapshot(self, guard_carry=None):
         """Escalation stage 3: reinstall the newest last-good snapshot.
 
-        In-flight lagged stats belong to steps computed from the
-        abandoned state chain and are DROPPED unprocessed; the dispatch
-        counter keeps advancing so the replayed steps draw fresh dropout
-        streams instead of re-living the exact batch/noise combination
-        that blew up.  The anomaly STREAK (and the skip/spike totals)
-        carry over from the live guard rather than the snapshot's —
-        the snapshot was taken on a clean step with streak 0, and
-        restoring that would make a persistent fault loop
+        At ``--pipeline-depth 1``: in-flight lagged stats belong to
+        steps computed from the abandoned state chain and are DROPPED
+        unprocessed; the dispatch counter keeps advancing so the
+        replayed steps draw fresh dropout streams instead of re-living
+        the exact batch/noise combination that blew up.  At K>=2 the
+        ring entries still HOLD their staged batches: the discarded
+        dispatches are re-issued after the restore — same device
+        buffers, same dispatch ids (the counter rewinds by the discard
+        count), so the rng streams and the trajectory match a serial
+        run's exactly (the chaos bit-exactness contract).  The anomaly
+        STREAK (and the skip/spike totals) carry over from the
+        anomalous step's guard rather than the snapshot's — the
+        snapshot was taken on a clean step with streak 0, and restoring
+        that would make a persistent fault loop
         skip->rewind->skip->rewind forever with the abort rung
         unreachable; carrying the streak keeps ``--anomaly-abort-after``
-        a real bound on consecutive anomalies across rewinds."""
+        a real bound on consecutive anomalies across rewinds.
+        ``guard_carry`` (K>=2) supplies those counters from the
+        processed step's host-side stats — the live head guard would
+        already include the discarded in-flight dispatches' updates."""
         entry = self._snapshot_ring.latest() if self._snapshot_ring else None
         if entry is None:  # decide() guarantees has_ring, but stay safe
             raise FloatingPointError(
@@ -1371,10 +1639,26 @@ class Trainer:
             )
         from unicore_tpu.resilience import restore_state
 
-        live_guard = jax.device_get(self.state["guard"])
+        live_guard = (jax.device_get(self.state["guard"])
+                      if guard_carry is None else guard_carry)
+        # K>=2: dispatches issued past the anomaly computed from the
+        # abandoned state chain — discard their results, requeue their
+        # staged batches (front, in order) and rewind the dispatch
+        # counter so the re-issues reuse the SAME ids/rng streams
+        replay = [e[4] for e in self._pending_stats if e[4] is not None]
         self._pending_stats.clear()
+        if replay and self.pipeline_depth > 1:
+            self._replay_queue[:0] = replay
+            self._dispatch_count -= len(replay)
+            logger.warning(
+                "anomaly guard: discarding %d in-flight dispatch(es) "
+                "issued past the anomaly; their batches replay from "
+                "dispatch %d", len(replay), self._dispatch_count,
+            )
         self.state = restore_state(snap)
-        for key in ("streak", "skips", "spikes"):
+        from unicore_tpu.resilience import GUARD_CARRY_KEYS
+
+        for key in GUARD_CARRY_KEYS:
             leaf = self.state["guard"][key]
             self.state["guard"][key] = jax.device_put(
                 jnp.asarray(live_guard[key], leaf.dtype), leaf.sharding
@@ -1533,8 +1817,7 @@ class Trainer:
         multihost = jax.process_count() > 1
         seq_size = self._mesh_shape.get("seq", 1)
 
-        def put(x):
-            x = np.asarray(x)
+        def sharding_for(x):
             dim = 1 if stacked_micro else 0
             n_local_shards = int(np.prod(self.mesh.devices.shape[:2]))
             if multihost:
@@ -1548,18 +1831,37 @@ class Trainer:
                 if (seq_size > 1 and x.ndim > dim + 1
                         and x.shape[dim + 1] % seq_size == 0):
                     spec[dim + 1] = "seq"
-                s = jax.sharding.NamedSharding(
+                return jax.sharding.NamedSharding(
                     self.mesh, jax.sharding.PartitionSpec(*spec)
                 )
-                if multihost:
+            return None  # replicated
+
+        if multihost:
+            def put(x):
+                x = np.asarray(x)
+                s = sharding_for(x)
+                if s is not None:
                     # each host holds its own shard of the global batch
                     # (the iterator sharded by process rank); assemble the
                     # global array from per-process data
                     return jax.make_array_from_process_local_data(s, x)
-                return jax.device_put(jnp.asarray(x), s)
-            return jax.device_put(jnp.asarray(x), rep)
+                return jax.device_put(jnp.asarray(x), rep)
 
-        return utils.tree_map_arrays(put, batch)
+            return utils.tree_map_arrays(put, batch)
+        # single host: ONE device_put over the whole tree — per-leaf
+        # eager puts each pay the dispatch-contention tax on the step
+        # boundary (measured ~10x a clean put while a step is in flight)
+        arrays = utils.tree_map_arrays(np.asarray, batch)
+        if self.mesh.devices.size == 1:
+            # one device: no sharding semantics to commit, and the
+            # compiled call's own argument conversion is cheaper than
+            # an eager transfer on the boundary critical path — hand
+            # the host arrays straight through
+            return arrays
+        shardings = utils.tree_map_arrays(
+            lambda x: sharding_for(x) or rep, arrays
+        )
+        return jax.device_put(arrays, shardings)
 
     # ------------------------------------------------------------------
     # lr / updates / misc parity surface

@@ -73,6 +73,11 @@ class TrainLoop:
         # until the first boundary snapshots a baseline — a resumed
         # run's restored skip-log history must not read as fresh skips
         self._data_seen = None
+        # pipelined dispatch (--pipeline-depth >= 2): boundary checks
+        # (writer poll, data health) ride the DRAIN point — this
+        # watermark tells a boundary whether the trainer retired any
+        # step since the last one
+        self._retired_seen = 0
 
     # -- stop conditions ----------------------------------------------
 
@@ -269,22 +274,11 @@ class TrainLoop:
 
     def validate_and_save(self, epoch_itr, end_of_epoch):
         args = self.args
-        # a background checkpoint write that failed since the last
-        # boundary surfaces HERE, on the main thread, before anything
-        # else this round — the run must never keep training on the
-        # belief that a save landed when it did not
-        self.ckpt.poll()
-        self._log_data_health(epoch_itr)
         # preemption (SIGTERM/SIGINT): flush the lagged pipeline so the
         # checkpoint carries exact counts, write it, and stop — the save
         # rides the normal do_save=stop path below; validation is skipped
         # because the grace window is for persisting state, not metrics
         preempted = self.shutdown is not None and self.shutdown.requested
-        if preempted:
-            logger.warning(
-                "preemption: checkpointing and exiting at this step boundary"
-            )
-            self.trainer.flush_stats()
         # lagged-stats pipeline: flush when this round could owe an action
         # (interval conditions are evaluated on the exact processed count;
         # checkpoints/validation need exact meters) — in the common
@@ -301,6 +295,26 @@ class TrainLoop:
             and opt_updates > 0
             and opt_updates % args.validate_interval_updates == 0
         )
+        retired = self.trainer.retired_steps
+        drained = retired != self._retired_seen
+        self._retired_seen = retired
+        if (self.trainer.pipeline_depth <= 1 or drained or may_act
+                or preempted):
+            # a background checkpoint write that failed since the last
+            # boundary surfaces HERE, on the main thread, before anything
+            # else this round — the run must never keep training on the
+            # belief that a save landed when it did not.  At
+            # --pipeline-depth >= 2 these checks ride the DRAIN point:
+            # while the in-flight ring fills (no step retired, no action
+            # due) they would only serialize dispatch — steady state
+            # drains every boundary, so the poll cadence is unchanged.
+            self.ckpt.poll()
+            self._log_data_health(epoch_itr)
+        if preempted:
+            logger.warning(
+                "preemption: checkpointing and exiting at this step boundary"
+            )
+            self.trainer.flush_stats()
         if may_act:
             self.trainer.flush_stats()
             opt_updates = self.trainer.get_num_updates()
