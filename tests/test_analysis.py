@@ -1229,14 +1229,15 @@ def test_ul204_collective_divergence():
 
 def test_ul205_serve_recompiles():
     from unicore_tpu.analysis.hlo_audit import audit_serve_recompiles
-    from unicore_tpu.serve.engine import _pow2_bucket
 
-    declared = (8, 16, 32, 64, 128)
-    assert audit_serve_recompiles(_pow2_bucket, declared, 92) == []
-    # a broken bucket fn: one lowering per prompt length
-    found = audit_serve_recompiles(lambda n: max(n, 8), declared, 92)
+    # the unified ragged step's constant two-width surface is clean
+    declared = (1, 32)
+    width_fn = lambda m: 1 if m <= 1 else 32  # noqa: E731
+    assert audit_serve_recompiles(width_fn, declared, 32) == []
+    # a broken width fn: one lowering per chunk size
+    found = audit_serve_recompiles(lambda m: max(m, 8), declared, 92)
     assert rules_of(found) == {"UL205"}
-    # lengths 1..92 through max(n, 8): 85 distinct lowerings
+    # chunk sizes 1..92 through max(m, 8): 85 distinct lowerings
     assert "85 distinct" in found[0].message
 
 
@@ -1380,12 +1381,16 @@ def test_serve_jits_trace_clean_through_pass1_and_pass3(tmp_path):
     )
 
     engine = build_demo_serve_engine()
-    assert engine.prefill_buckets() == (8, 16, 32, 64, 128)
+    # the ragged unification's whole point: the compile surface is a
+    # CONSTANT two widths, independent of prompt length (the old
+    # per-pow2-bucket family here was (8, 16, 32, 64, 128) + decode)
+    assert engine.serve_step_widths() == (1, engine.prefill_chunk)
     assert hlo_audit.audit_serve_recompiles(
-        engine.bucket_fn, engine.prefill_buckets(), engine.max_context
+        engine.width_fn, engine.serve_step_widths(),
+        engine.prefill_chunk,
     ) == []
-    arts = engine.trace_step_fns(buckets=(8,))
-    assert set(arts) == {"prefill-b8", "decode"}
+    arts = engine.trace_step_fns()
+    assert set(arts) == {"ragged-w1", f"ragged-w{engine.prefill_chunk}"}
     for name, art in arts.items():
         found = audit_jaxpr(art["jaxpr"], context=f"serve/{name}")
         found += audit_donation(art["lowered"], context=f"serve/{name}")
@@ -1396,10 +1401,12 @@ def test_serve_jits_trace_clean_through_pass1_and_pass3(tmp_path):
             compiled, context=f"serve/{name}"
         )
         assert stats["peak_bytes"] is None or stats["peak_bytes"] > 0
-    # a sabotaged bucket fn is caught statically before it can compile
-    engine.bucket_fn = lambda n, floor=8: max(n, floor)
+    # a sabotaged width fn (one lowering per chunk size — the
+    # recompile explosion) is caught statically before it can compile
+    engine.width_fn = lambda m: max(m, 1)
     found = hlo_audit.audit_serve_recompiles(
-        engine.bucket_fn, engine.prefill_buckets(), engine.max_context
+        engine.width_fn, engine.serve_step_widths(),
+        engine.prefill_chunk,
     )
     assert rules_of(found) == {"UL205"}
 

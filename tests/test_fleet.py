@@ -186,6 +186,8 @@ def test_load_snapshot_is_stable_typed_dict(lm):
         "free_pages": int, "total_pages": int, "waiting": int,
         "running": int, "free_slots": int, "max_waiting": int,
         "draining": bool, "step_ms": float,
+        "prefix_hits": int, "prefix_tokens_saved": int,
+        "prefix_hit_rate": float,
     }
     assert set(snap) == set(want_types), snap
     for k, t in want_types.items():

@@ -351,9 +351,14 @@ def compare_trajectories(oracle, chaos_records):
 SERVE_POOL = dict(num_pages=24, page_size=4, max_batch=4)
 
 
-def _serve_demo_setup(seed, num_requests=6, max_new=8):
+def _serve_demo_setup(seed, num_requests=6, max_new=8,
+                      shared_prefix=0):
     """Seeded demo model + mixed-length requests (greedy, so every
-    comparison below is exact token identity, no sampling slack)."""
+    comparison below is exact token identity, no sampling slack).
+    ``shared_prefix`` > 0 opens EVERY EVEN-indexed request with the
+    same system prompt of that many tokens — the poison leg uses it to
+    put the poisoned request's pages under prefix sharing with a
+    survivor."""
     import numpy as np
 
     from unicore_tpu.serve.cli import _demo_model
@@ -361,11 +366,15 @@ def _serve_demo_setup(seed, num_requests=6, max_new=8):
 
     model, params = _demo_model(seed)
     rng = np.random.default_rng(seed)
+    system = [int(t) for t in
+              rng.integers(1, model.vocab_size, size=(shared_prefix,))]
     reqs = []
     for i in range(num_requests):
         n = int(rng.integers(3, 17))
         prompt = [int(t) for t in
                   rng.integers(1, model.vocab_size, size=(n,))]
+        if shared_prefix and i % 2 == 0:
+            prompt = list(system) + prompt
         reqs.append(Request(
             prompt=prompt, max_new_tokens=max_new, seed=seed + i,
             request_id=f"demo-{i}",
@@ -397,16 +406,24 @@ def _solo_tokens(model, params, req):
 def serve_poison_leg(args, report):
     """Poisoned-request injection: the poisoned row is quarantined
     (``failed``, pages freed) and every survivor is bit-identical to
-    its solo oracle."""
+    its solo oracle — INCLUDING survivors whose pages are
+    prefix-SHARED with the poisoned request (every even-indexed demo
+    request opens with the same system prompt, so the quarantine's
+    page free is a refcount drop on shared pages, never a content
+    mutation)."""
     from unicore_tpu.serve.engine import ServeEngine
 
     at = int(args.inject.partition(":")[2])
-    model, params, reqs = _serve_demo_setup(args.seed)
+    # poison an even index so the victim SHARES its prefix pages with
+    # the other even-indexed survivors
+    at = at if at % 2 == 0 else at - 1
+    model, params, reqs = _serve_demo_setup(args.seed, shared_prefix=9)
     if not 0 <= at < len(reqs):
         raise SystemExit(f"poison index {at} outside 0..{len(reqs) - 1}")
     poisoned_id = f"demo-{at}"
     print(f"[chaos] serve poison leg: NaN'ing {poisoned_id}'s logits "
-          f"row inside the jitted step", flush=True)
+          f"row inside the jitted step (its prefix pages are shared "
+          f"with the even-indexed survivors)", flush=True)
     engine = ServeEngine(model, params, poison_requests=[poisoned_id],
                          **SERVE_POOL)
     results = engine.generate(reqs)
@@ -429,6 +446,8 @@ def serve_poison_leg(args, report):
         "survivors_exact": not mismatches,
         "mismatches": mismatches[:5],
         "pool_idle": engine.pool.is_idle(),
+        "prefix_hits": engine.pool.prefix_stats["hits"],
+        "prefix_tokens_saved": engine.pool.prefix_stats["tokens_saved"],
     }
     if bad.finish_reason != "failed":
         raise RuntimeError(
@@ -442,6 +461,11 @@ def serve_poison_leg(args, report):
         )
     if not report["poison"]["pool_idle"]:
         raise RuntimeError("poison leg: pool pages leaked")
+    if report["poison"]["prefix_hits"] < 1:
+        raise RuntimeError(
+            "poison leg: the shared system prompt never hit the prefix "
+            "cache — the quarantined-sharer scenario was not exercised"
+        )
 
 
 def serve_flood_leg(args, report):

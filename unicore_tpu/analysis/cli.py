@@ -90,8 +90,9 @@ def build_parser():
     )
     p.add_argument(
         "--pass3-serve", action="store_true",
-        help="Pass 3 over the demo ServeEngine: trace/lower every "
-             "prefill bucket + the decode step (Pass-1 rules included) "
+        help="Pass 3 over the demo ServeEngine: trace/lower the "
+             "unified ragged step at its constant two widths plus "
+             "the sampling variants (Pass-1 rules included) "
              "and audit recompile surface + budgets (UL205, "
              "UL202/UL203)",
     )

@@ -31,9 +31,10 @@ Rules (UL2xx family, locations ``hlo:<scenario>``):
 - UL204 collective-divergence: two program variants declared to match
   (the grad-accumulation scan body vs the fused single-micro-batch
   path of the same mesh) compile to different collective multisets.
-- UL205 serve-recompile: the serving bucket function produces more
-  distinct prefill lowerings than the engine's declared bucket set —
-  the recompile-per-prompt-length explosion.
+- UL205 serve-recompile: the serving ragged-step width function
+  produces more distinct lowerings than the engine's declared
+  (constant, prompt-length-independent) width set — the
+  recompile-per-prompt-length explosion.
 
 Budgets are keyed by an environment fingerprint (device kind, device
 count, jax version — the same self-invalidation idiom as the kernel
@@ -502,16 +503,21 @@ def audit_sequence_match(group_name, members, *, max_listed=4):
 # UL205 — serve recompile explosion
 # ---------------------------------------------------------------------
 
-def audit_serve_recompiles(bucket_fn, declared, max_context, *,
+def audit_serve_recompiles(width_fn, declared, max_chunk, *,
                            context="serve"):
-    """UL205: simulate every admissible prompt length through the
-    engine's bucket function; each distinct bucket is one prefill
-    executable, and every bucket outside the declared set is a
-    recompile the engine never planned for."""
+    """UL205: simulate every ragged chunk size the engine's admission
+    can produce (a prompt of ANY length is sliced into chunks of
+    1..max_chunk tokens, so this covers every prompt length) through
+    its width function; each distinct width is one compiled serve
+    executable, and every width outside the declared set is a
+    recompile the engine never planned for.  The declared set is
+    CONSTANT — two widths, independent of prompt length — which is the
+    whole point of the ragged unification (the old per-pow2-bucket
+    prefill family grew with the context)."""
     declared = set(declared)
     seen = set()
-    for n in range(1, max_context + 1):
-        seen.add(int(bucket_fn(n)))
+    for m in range(1, max_chunk + 1):
+        seen.add(int(width_fn(m)))
     extra = sorted(b for b in seen if b not in declared)
     if not extra:
         return []
@@ -519,11 +525,11 @@ def audit_serve_recompiles(bucket_fn, declared, max_context, *,
     more = f" (+{len(extra) - 8} more)" if len(extra) > 8 else ""
     return [Finding(
         "UL205", "serve-recompile", "error", f"hlo:{context}",
-        f"prompt bucketing produces {len(seen)} distinct prefill "
-        f"lowerings but the engine declares {len(declared)} buckets; "
-        f"undeclared buckets: {shown}{more} — each is a fresh XLA "
-        f"compile at serve time (the recompile-per-prompt-length "
-        f"explosion)",
+        f"ragged-step width mapping produces {len(seen)} distinct "
+        f"serve lowerings but the engine declares {len(declared)} "
+        f"widths; undeclared widths: {shown}{more} — each is a fresh "
+        f"XLA compile at serve time (the recompile-per-prompt-length "
+        f"explosion the unified ragged step exists to prevent)",
     )]
 
 

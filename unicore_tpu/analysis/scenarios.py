@@ -335,7 +335,7 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
 
 def build_demo_serve_engine(seed=1):
     """The ``unicore-serve --demo`` engine at the CI smoke settings: a
-    pool small enough that paging is real, every prefill bucket
+    pool small enough that paging is real, both ragged-step widths
     reachable."""
     from unicore_tpu.serve.cli import _demo_model
     from unicore_tpu.serve.engine import ServeEngine
@@ -348,12 +348,16 @@ def build_demo_serve_engine(seed=1):
 def audit_serve_demo(*, budget_path=None, update_budgets=False,
                      tolerance=None, thresholds=None, log=None,
                      engine=None):
-    """Pass 1 + Pass 3 over the demo ServeEngine's prefill/decode jits.
+    """Pass 1 + Pass 3 over the demo ServeEngine's unified ragged jits.
 
-    Every executable the engine can dispatch (one prefill per declared
-    bucket + the decode step) is traced, donation/jaxpr-audited, and
-    compiled for the budget rules — without executing on device.
-    Returns (findings, report).
+    The engine's compile surface is CONSTANT since the ragged
+    unification: two widths of ONE step function (the pure-decode
+    width-1 program and the prefill-chunk program) per sampling
+    variant, independent of prompt length — UL205 simulates every
+    chunk size the admission can produce and fails on any width
+    outside the declared set.  Every executable is traced,
+    donation/jaxpr-audited, and compiled for the budget rules —
+    without executing on device.  Returns (findings, report).
     """
     from unicore_tpu.analysis import hlo_audit, trace_audit
     from unicore_tpu.analysis.trace_audit import audit_donation, audit_jaxpr
@@ -362,18 +366,19 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
     engine = engine or build_demo_serve_engine()
     tol = hlo_audit.DEFAULT_TOLERANCE if tolerance is None else tolerance
     findings = list(hlo_audit.audit_serve_recompiles(
-        engine.bucket_fn, engine.prefill_buckets(), engine.max_context,
+        engine.width_fn, engine.serve_step_widths(),
+        engine.prefill_chunk,
     ))
-    # every executable generate() can dispatch: all prefill buckets
-    # under the default greedy composition, plus the decode step under
-    # each sampling variant (the variants differ only in the
-    # _pick_tokens composition, identical between prefill and decode,
-    # so decode-only coverage of temp/topk audits the sampling paths
-    # without tripling the prefill compiles)
+    # every executable serve_step can dispatch: both widths under the
+    # default greedy composition, plus the width-1 program under each
+    # sampling variant (the variants differ only in the _pick_tokens
+    # composition, identical across widths, so width-1 coverage of
+    # temp/topk audits the sampling paths without doubling the
+    # chunk-width compiles)
     arts = dict(engine.trace_step_fns(sampling="greedy"))
     for sampling in ("temp", "topk"):
-        got = engine.trace_step_fns(sampling=sampling, buckets=())
-        arts[f"decode-{sampling}"] = got["decode"]
+        got = engine.trace_step_fns(sampling=sampling, widths=(1,))
+        arts[f"decode-{sampling}"] = got["ragged-w1"]
     scenario_stats = {}
     scenarios_report = []
     for name, art in sorted(arts.items()):

@@ -1,20 +1,29 @@
-"""Ragged paged-attention decode kernel (Pallas/TPU).
+"""Ragged paged-attention kernel (Pallas/TPU): mixed prefill + decode.
 
-One grid program per sequence: the program walks that sequence's page
+One grid program per batch row.  A row carries ``T`` query tokens at
+per-token global ``positions`` ([B, T] int32, -1 = inactive padding): a
+DECODE row has one real token, a PREFILL-CHUNK row up to ``T`` — both
+shapes run in the SAME program, which is what lets the serve engine
+dispatch a mixed batch in one compiled step (the "Ragged Paged
+Attention" shape, arxiv 2604.15464).  The program walks that row's page
 table (scalar-prefetched into SMEM), DMAs each block of
 ``pages_per_block`` KV pages HBM -> VMEM scratch, and folds them into an
-online-softmax accumulator — the ``[B, S, H, D]`` gathered key/value
-tensor the eager path materializes never exists, and per-sequence
-lengths make the work RAGGED: a sequence holding 3 pages stops after 3
-DMAs regardless of the table width (the "Ragged Paged Attention" shape,
-arxiv 2604.15464).
+online-softmax accumulator per (head, query) — the gathered
+``[B, S, H, D]`` key/value tensor the eager path materializes never
+exists, and per-row ``lengths`` make the work RAGGED: a row holding 3
+pages stops after 3 DMAs regardless of the table width.
 
-Decode-step only (``T == 1``): prefill has enough arithmetic intensity
-that the gather + einsum composition feeds the MXU well; the decode
-step is gather-bound, which is exactly what the manual DMA pipeline
-addresses.  Dispatch (serve/attention.py) gates on ``use_pallas`` + the
-autotuner verdict and compile-probes fail-open, so this kernel can only
-ever replace the eager path where it lowers and measures faster.
+Causality is one compare: gathered column ``j`` of a row's view IS
+position ``j`` (the pool layout invariant), so column ``c`` is admitted
+for query ``t`` iff ``c <= positions[b, t]`` — which also excludes
+unwritten/stale slots, since every real query position is below the
+row's length.  Inactive query columns (position -1) mask everything and
+come out finite (garbage by contract, discarded by the caller).
+
+Dispatch (serve/attention.py) gates on ``use_pallas`` + the autotuner
+verdict (op ``"ragged_paged_attention"``) and compile-probes fail-open,
+so this kernel can only ever replace the eager path where it lowers and
+measures faster.
 """
 
 import functools
@@ -53,16 +62,18 @@ def pick_pages_per_block(num_table_pages, page_size, head_dim, tuned=None,
     return pp
 
 
-def _kernel(pt_ref, len_ref, q_ref, kp_hbm, vp_hbm, o_ref, k_scr, v_scr,
-            sems, *, page_size, pages_per_block, scale):
+def _kernel(pt_ref, len_ref, pos_ref, q_ref, kp_hbm, vp_hbm, o_ref,
+            k_scr, v_scr, sems, *, page_size, pages_per_block, scale):
     b = pl.program_id(0)
     length = len_ref[b]
     n_table = pt_ref.shape[1]
     blk_slots = pages_per_block * page_size
     n_blocks = pl.cdiv(length, blk_slots)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
-    heads, d = q.shape
+    q = q_ref[0].astype(jnp.float32) * scale  # [T, H, D]
+    t, heads, d = q.shape
+    # query positions [1, T, 1]: -1 marks an inactive column (mask all)
+    pos_q = pos_ref[0][None, :, None]
 
     def body(i, carry):
         m, l, acc = carry
@@ -83,43 +94,55 @@ def _kernel(pt_ref, len_ref, q_ref, kp_hbm, vp_hbm, o_ref, k_scr, v_scr,
             cp.wait()
         k = k_scr[...].astype(jnp.float32).reshape(blk_slots, heads, d)
         v = v_scr[...].astype(jnp.float32).reshape(blk_slots, heads, d)
-        s = jax.lax.dot_general(  # [H, blk]: q[h,:] . k[s,h,:] per head
-            q, k, (((1,), (2,)), ((0,), (1,))),
+        # [H, T, S]: batch over heads, contract head_dim
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
             preferred_element_type=jnp.float32,
         )
-        pos = i * blk_slots + jax.lax.broadcasted_iota(
-            jnp.int32, (1, blk_slots), 1
+        cols = i * blk_slots + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, blk_slots), 2
         )
-        s = jnp.where(pos < length, s, -1e30)
+        # bottom-right causal + unwritten-slot exclusion in one compare
+        # (every real query position is < length by construction)
+        valid = cols <= pos_q
+        s = jnp.where(valid, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # a query whose positions precede this whole block has m_new ==
+        # -1e30 == s; exp(0) would admit every masked column, so the
+        # probability is zeroed explicitly rather than through the
+        # subtraction
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(  # [H, D]: p[h,:] . v[s,h,:] per head
-            p, v, (((1,), (0,)), ((0,), (1,))),
+        pv = jax.lax.dot_general(  # [H, T, D]
+            p, v, (((2,), (0,)), ((0,), (1,))),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc * alpha + pv
 
     init = (
-        jnp.full((heads, 1), -1e30, jnp.float32),
-        jnp.zeros((heads, 1), jnp.float32),
-        jnp.zeros((heads, d), jnp.float32),
+        jnp.full((heads, t, 1), -1e30, jnp.float32),
+        jnp.zeros((heads, t, 1), jnp.float32),
+        jnp.zeros((heads, t, d), jnp.float32),
     )
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, init)
-    # inactive batch slots (length 0) never enter the loop; keep them
-    # finite instead of 0/0
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # inactive rows/columns never accumulate; keep them finite instead
+    # of 0/0
+    out = acc / jnp.maximum(l, 1e-30)          # [H, T, D]
+    o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
-def _call(q3, k_pages4, v_pages4, page_table, lengths, *, page_size,
-          pages_per_block, scale):
-    bsz, heads, d = q3.shape
-    qo_spec = pl.BlockSpec((1, heads, d), lambda b, pt, ln: (b, 0, 0))
+def _call(q3, k_pages4, v_pages4, page_table, lengths, positions, *,
+          page_size, pages_per_block, scale):
+    bsz, t, heads, d = q3.shape
+    qo_spec = pl.BlockSpec((1, t, heads, d),
+                           lambda b, pt, ln: (b, 0, 0, 0))
+    pos_spec = pl.BlockSpec((1, t), lambda b, pt, ln: (b, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bsz,),
         in_specs=[
+            pos_spec,
             qo_spec,
             pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -137,60 +160,76 @@ def _call(q3, k_pages4, v_pages4, page_table, lengths, *, page_size,
             scale=float(scale),
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bsz, heads, d), q3.dtype),
+        out_shape=jax.ShapeDtypeStruct((bsz, t, heads, d), q3.dtype),
         interpret=pallas_interpret(),
         compiler_params=tpu_compiler_params(
             # the scratch/DMA pattern serializes programs on-core anyway
             dimension_semantics=("arbitrary",),
         ),
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q3, k_pages4, v_pages4)
+      positions.astype(jnp.int32), q3, k_pages4, v_pages4)
 
 
-def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
-                            page_size, scale, pages_per_block=None):
-    """Paged decode attention: q [B, 1, H, D], flat pools
+def ragged_paged_attention(q, k_pages, v_pages, page_table, positions,
+                           lengths, *, page_size, scale,
+                           pages_per_block=None):
+    """Mixed prefill+decode paged attention: q [B, T, H, D], flat pools
     [num_slots, H, D], page_table [B, P] (pad rows with page 0),
-    lengths [B] (0 = inactive slot).  Returns [B, 1, H, D]."""
-    assert q.shape[1] == 1, "the ragged kernel is decode-step only"
-    heads, d = q.shape[2], q.shape[3]
+    positions [B, T] per-token global positions (-1 = inactive),
+    lengths [B] valid token count incl. this step's (0 = inactive row).
+    Returns [B, T, H, D]."""
+    bsz, t, heads, d = q.shape
     num_pages = k_pages.shape[0] // page_size
     if pages_per_block is None:
         pages_per_block = pick_pages_per_block(
             page_table.shape[1], page_size, d, num_heads=heads,
             itemsize=q.dtype.itemsize,
         )
-    out = _call(
-        q[:, 0],
+    return _call(
+        q,
         k_pages.reshape(num_pages, page_size, heads, d),
         v_pages.reshape(num_pages, page_size, heads, d),
-        page_table, lengths,
+        page_table, lengths, positions,
         page_size=page_size, pages_per_block=pages_per_block, scale=scale,
     )
-    return out[:, None]
 
 
-def probe_ok(dtype, bsz, heads, d, num_pages, page_size, table_pages,
-             pages_per_block):
+def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                            page_size, scale, pages_per_block=None):
+    """Decode-step convenience wrapper (T == 1): each row's single
+    query sits at its last valid position."""
+    assert q.shape[1] == 1, "use ragged_paged_attention for T > 1"
+    positions = (lengths - 1)[:, None].astype(jnp.int32)
+    return ragged_paged_attention(
+        q, k_pages, v_pages, page_table, positions, lengths,
+        page_size=page_size, scale=scale, pages_per_block=pages_per_block,
+    )
+
+
+def probe_ok(dtype, bsz, width, heads, d, num_pages, page_size,
+             table_pages, pages_per_block):
     """Fail-open compile probe (see ``backend.kernel_probe_ok``): lower
-    a single-sequence config with the production page_size/heads/head-dim
-    and block shape — the dims that pick the DMA/layout lowering; grid
-    size (batch) and pool page count shrink to minimum."""
+    a single-sequence config with the production width/page_size/heads/
+    head-dim and block shape — the dims that pick the DMA/layout
+    lowering; grid size (batch) and pool page count shrink to minimum."""
     del bsz, num_pages, table_pages  # grid/pool/table size never
     # changes the lowering; only the block shape and dtypes do
-    key = ("paged_attention", str(dtype), heads, d, int(page_size),
-           int(pages_per_block))
+    key = ("ragged_paged_attention", str(dtype), int(width), heads, d,
+           int(page_size), int(pages_per_block))
 
     def build():
         pp = int(pages_per_block)
+        w = int(width)
         kp = jnp.zeros(((pp + 1) * page_size, heads, d), dtype)
-        q = jnp.zeros((1, 1, heads, d), dtype)
+        q = jnp.zeros((1, w, heads, d), dtype)
         pt = jnp.zeros((1, max(pp, 1)), jnp.int32)
         ln = jnp.full((1,), page_size, jnp.int32)
+        pos = jnp.minimum(jnp.arange(w, dtype=jnp.int32),
+                          page_size - 1)[None]
         fn = functools.partial(
-            ragged_decode_attention, page_size=int(page_size),
+            ragged_paged_attention, page_size=int(page_size),
             scale=1.0, pages_per_block=pp,
         )
-        jax.jit(fn).lower(q, kp, kp, pt, ln).compile()
+        jax.jit(fn).lower(q, kp, kp, pt, pos, ln).compile()
 
     return kernel_probe_ok(key, build)

@@ -45,7 +45,7 @@ from unicore_tpu.ops.tuning.cache import (  # noqa: F401
 )
 from unicore_tpu.ops.tuning.candidates import (  # noqa: F401
     OPS, PRESETS, ce_workload, describe_config, flash_workload, ln_workload,
-    paged_workload, pow2_bucket, sd_workload,
+    pow2_bucket, ragged_workload, sd_workload,
 )
 
 logger = logging.getLogger(__name__)
@@ -313,17 +313,19 @@ def tuned_ce_chunk(rows, decision):
     return min(chunk, int(rows))
 
 
-def paged_decision(q_shape, table_pages, page_size, dtype,
-                   allow_tune=False):
-    """Serve-tier ragged decode attention (q_shape [B, 1, H, D])."""
-    return _decision("paged_attention", paged_workload(
+def ragged_paged_decision(q_shape, table_pages, page_size, dtype,
+                          allow_tune=False):
+    """Serve-tier unified ragged prefill+decode attention (q_shape
+    [B, T, H, D]; T = the engine's prefill-chunk width, 1 for the
+    pure-decode dispatch)."""
+    return _decision("ragged_paged_attention", ragged_workload(
         q_shape, table_pages, page_size, dtype,
     ), allow_tune=allow_tune)
 
 
 def tuned_pages_per_block(table_pages, decision):
-    """Validate a cached paged-attention config against the actual
-    table width; None -> use the heuristic."""
+    """Validate a cached ragged-paged-attention config against the
+    actual table width; None -> use the heuristic."""
     if not isinstance(decision, dict):
         return None
     try:
@@ -333,3 +335,23 @@ def tuned_pages_per_block(table_pages, decision):
     if pp < 1 or pp > table_pages:
         return None
     return pp
+
+
+def tuned_prefill_chunk(decision, max_chunk):
+    """Prefill-chunk width a measured ragged-step verdict recommends
+    (a ``{"prefill_chunk": c}`` candidate beat the full-width dispatch
+    for the bucket); None -> no measured preference.  Candidates are
+    only ever generated BELOW the consulted width, so a verdict above
+    ``max_chunk`` is a stale/corrupt cache entry and is rejected — the
+    same validation idiom as :func:`tuned_pages_per_block` (silently
+    widening the compiled step would destroy the bounded-TTFT property
+    the chunk knob exists to guarantee)."""
+    if not isinstance(decision, dict):
+        return None
+    try:
+        c = int(decision["prefill_chunk"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if c < 1 or c > int(max_chunk):
+        return None
+    return c

@@ -297,14 +297,15 @@ def _flash_shrink(wl):
 
 
 # ---------------------------------------------------------------------------
-# paged attention (serve-tier ragged decode step)
+# ragged paged attention (serve-tier unified prefill+decode step)
 # ---------------------------------------------------------------------------
 
 
-def paged_workload(q_shape, table_pages, page_size, dtype):
-    """q_shape: module layout [B, 1, H, D] (decode step)."""
+def ragged_workload(q_shape, table_pages, page_size, dtype):
+    """q_shape: module layout [B, T, H, D] — T is the serve engine's
+    prefill-chunk width (1 = the pure-decode dispatch)."""
     return {
-        "op": "paged_attention",
+        "op": "ragged_paged_attention",
         "q_shape": tuple(int(s) for s in q_shape),
         "table_pages": int(table_pages),
         "page_size": int(page_size),
@@ -312,19 +313,20 @@ def paged_workload(q_shape, table_pages, page_size, dtype):
     }
 
 
-def _paged_bucket(wl):
-    bsz, _, heads, d = wl["q_shape"]
-    # batch is bucketed (the serve engine's fixed max_batch makes it
-    # near-static anyway); heads/head-dim/page-size exact — they pick
-    # the scratch layout and DMA shape
-    return ("paged_attention", wl["dtype"], pow2_bucket(bsz), heads, d,
-            wl["page_size"], pow2_bucket(wl["table_pages"]))
+def _ragged_bucket(wl):
+    bsz, t, heads, d = wl["q_shape"]
+    # batch/chunk are bucketed (the serve engine's fixed max_batch and
+    # chunk width make them near-static anyway); heads/head-dim/
+    # page-size exact — they pick the scratch layout and DMA shape
+    return ("ragged_paged_attention", wl["dtype"], pow2_bucket(bsz),
+            pow2_bucket(t), heads, d, wl["page_size"],
+            pow2_bucket(wl["table_pages"]))
 
 
-def _paged_candidates(wl):
+def _ragged_candidates(wl):
     from unicore_tpu.ops.pallas.paged_attention import pick_pages_per_block
 
-    _, _, heads, d = wl["q_shape"]
+    _, t, heads, d = wl["q_shape"]
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(wl["dtype"]).itemsize
@@ -336,62 +338,85 @@ def _paged_candidates(wl):
     for pp in (1, 2, 4, 8):
         if pp <= wl["table_pages"] and pp not in pps:
             pps.append(pp)
-    return ["eager"] + [
-        {"pages_per_block": pp} for pp in pps[:MAX_KERNEL_CANDIDATES]
-    ]
+    cands = ["eager"] + [{"pages_per_block": pp} for pp in pps]
+    # prefill-chunk candidates: the same prompt slice admitted in
+    # halved-width chunks (more dispatches of a narrower program) —
+    # what the engine's --prefill-chunk auto pick consults via
+    # tuned_prefill_chunk
+    c = t // 2
+    while c >= 8 and len(cands) < 1 + MAX_KERNEL_CANDIDATES:
+        cands.append({"pages_per_block": heuristic, "prefill_chunk": c})
+        c //= 2
+    return cands[: 1 + MAX_KERNEL_CANDIDATES]
 
 
-def _paged_args(wl):
+def _ragged_args(wl, width):
     import jax.numpy as jnp
 
     bsz, _, heads, d = wl["q_shape"]
     pages, ps = wl["table_pages"], wl["page_size"]
     num_pages = bsz * pages + 1  # page 0 reserved (trash)
-    q = _zeros(wl["q_shape"], wl["dtype"])
+    q = _zeros((bsz, width, heads, d), wl["dtype"])
     pool = _zeros((num_pages * ps, heads, d), wl["dtype"])
     table = (1 + jnp.arange(bsz * pages, dtype=jnp.int32).reshape(
         bsz, pages))
     lengths = jnp.full((bsz,), pages * ps, jnp.int32)
-    return q, pool, table, lengths
+    # the chunk's queries sit at the row's last `width` positions
+    positions = (lengths[:, None] - width
+                 + jnp.arange(width, dtype=jnp.int32)[None])
+    return q, pool, table, positions, lengths
 
 
-def _paged_runner(wl, config):
-    import jax
+def _ragged_runner(wl, config):
     import jax.numpy as jnp
 
-    q, pool, table, lengths = _paged_args(wl)
     ps = wl["page_size"]
     d = wl["q_shape"][3]
+    t = wl["q_shape"][1]
     scale = d ** -0.5
+    chunk = t
+    if config != "eager" and "prefill_chunk" in config:
+        chunk = max(1, min(int(config["prefill_chunk"]), t))
+    q, pool, table, positions, lengths = _ragged_args(wl, chunk)
+    n_calls = max(1, -(-t // chunk))  # chunked admission of the slice
 
     if config == "eager":
         from unicore_tpu.serve.attention import paged_attention_reference
-
-        positions = (lengths - 1)[:, None]
 
         def run(q_):
             return paged_attention_reference(
                 q_, pool, pool, table, positions, lengths, ps, scale
             ).astype(jnp.float32)
+    else:
+        from unicore_tpu.ops.pallas.paged_attention import (
+            ragged_paged_attention,
+        )
 
+        pp = int(config["pages_per_block"])
+
+        def run(q_):
+            return ragged_paged_attention(
+                q_, pool, pool, table, positions, lengths, page_size=ps,
+                scale=scale, pages_per_block=pp,
+            ).astype(jnp.float32)
+
+    if n_calls == 1:
         return _aot(run, q)
 
-    from unicore_tpu.ops.pallas.paged_attention import (
-        ragged_decode_attention,
-    )
+    def chunked(q_):
+        # serialize n dependent calls (feeding the previous output back
+        # into the next query defeats CSE): the timed cost is the whole
+        # chunked admission of the slice, not one narrow dispatch
+        out = run(q_)
+        for _ in range(n_calls - 1):
+            q_ = q_ + (0.0 * out.sum()).astype(q_.dtype)
+            out = run(q_)
+        return out
 
-    pp = int(config["pages_per_block"])
-
-    def run(q_):
-        return ragged_decode_attention(
-            q_, pool, pool, table, lengths, page_size=ps, scale=scale,
-            pages_per_block=pp,
-        ).astype(jnp.float32)
-
-    return _aot(run, q)
+    return _aot(chunked, q)
 
 
-def _paged_shrink(wl):
+def _ragged_shrink(wl):
     bsz = min(wl["q_shape"][0], 2)
     return dict(
         wl,
@@ -537,9 +562,9 @@ OPS = {
                     wl["hidden"]),
         _ln_candidates, _ln_runner, _ln_shrink,
     ),
-    "paged_attention": OpSpec(
-        "paged_attention", _paged_bucket, _paged_candidates, _paged_runner,
-        _paged_shrink,
+    "ragged_paged_attention": OpSpec(
+        "ragged_paged_attention", _ragged_bucket, _ragged_candidates,
+        _ragged_runner, _ragged_shrink,
     ),
     "fused_cross_entropy": OpSpec(
         "fused_cross_entropy", _ce_bucket, _ce_candidates, _ce_runner,
@@ -572,8 +597,12 @@ PRESETS = {
         (4, 2048, 12, 64), 2048, "bfloat16", causal=False, dropout_on=False,
     ),
     "layer_norm_bert": ln_workload(16384, 768, "bfloat16"),
-    # serve decode step: batch 8, 8 heads x 64, 16-token pages, 2k context
-    "paged_decode_b8": paged_workload((8, 1, 8, 64), 128, 16, "bfloat16"),
+    # unified serve step: batch 8, chunk 32, 8 heads x 64, 16-token
+    # pages, 2k context (the decode-only paged_decode_b8 preset retired
+    # with the per-bucket prefill jits — the width-1 dispatch is the
+    # same program family)
+    "ragged_serve_b8": ragged_workload((8, 32, 8, 64), 128, 16,
+                                       "bfloat16"),
     # MLM head at the batch-64 bench shape: 8192 static slots
     # (32768 tokens x 0.25 capacity), tied-embedding projection
     "fused_ce_bert": ce_workload(8192, 768, 30528, "bfloat16"),

@@ -15,13 +15,15 @@ Two implementations:
   runs everywhere (CPU tier-1), and is what XLA fuses well at small
   batch.
 - an optional **Pallas ragged kernel**
-  (``ops/pallas/paged_attention.py``) for the single-token decode step
-  on TPU: one grid program per sequence DMAs that sequence's pages
-  HBM -> VMEM and accumulates an online softmax — the gathered
-  ``[B, S, H, D]`` key tensor never materializes.  Gated through
-  ``ops/backend.py`` (``use_pallas`` + fail-open compile probe) and the
-  PR-2 autotuner: an ``"eager"`` verdict for the bucket routes around
-  the kernel, a config dict picks its page block.
+  (``ops/pallas/paged_attention.py``) for the serve engine's unified
+  step on TPU: one grid program per batch row — a row carries either a
+  single decode token or a prefill chunk, both in the SAME program —
+  DMAs that row's pages HBM -> VMEM and accumulates an online softmax
+  per (head, query); the gathered ``[B, S, H, D]`` key tensor never
+  materializes.  Gated through ``ops/backend.py`` (``use_pallas`` +
+  fail-open compile probe) and the PR-2 autotuner (op
+  ``"ragged_paged_attention"``): an ``"eager"`` verdict for the bucket
+  routes around the kernel, a config dict picks its page block.
 """
 
 import dataclasses
@@ -82,19 +84,19 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, positions,
 
 
 def _kernel_ok(q, k_pages, page_table, page_size):
-    """Whether the Pallas ragged-decode kernel should take this call:
-    TPU backend, single-token decode shape, tuner verdict not "eager",
-    and the config compile-probes (fail-open)."""
+    """Whether the Pallas ragged kernel should take this call: TPU
+    backend, tuner verdict not "eager", and the config compile-probes
+    (fail-open).  Both serve dispatch widths (the pure-decode T=1 and
+    the prefill-chunk T=C program) go through the same gate — the
+    bucket key carries the width."""
     from unicore_tpu.ops.backend import get_kernel_backend, use_pallas
 
     if not use_pallas():
         return None
-    if q.shape[1] != 1:  # prefill: the gather path feeds the MXU fine
-        return None
     from unicore_tpu.ops import tuning
     from unicore_tpu.ops.pallas import paged_attention as pl_pa
 
-    decision = tuning.paged_decision(
+    decision = tuning.ragged_paged_decision(
         q.shape, page_table.shape[1], page_size, q.dtype.name,
         allow_tune=True,
     )
@@ -106,7 +108,7 @@ def _kernel_ok(q, k_pages, page_table, page_size):
         num_heads=q.shape[2], itemsize=q.dtype.itemsize,
     )
     if not pl_pa.probe_ok(
-        q.dtype, q.shape[0], q.shape[2], q.shape[3],
+        q.dtype, q.shape[0], q.shape[1], q.shape[2], q.shape[3],
         k_pages.shape[0] // page_size, page_size, page_table.shape[1],
         pages_per_block,
     ):
@@ -121,8 +123,8 @@ def paged_attention(q, k_pages, v_pages, page_table, positions, lengths,
     if pages_per_block is not None:
         from unicore_tpu.ops.pallas import paged_attention as pl_pa
 
-        return pl_pa.ragged_decode_attention(
-            q, k_pages, v_pages, page_table, lengths,
+        return pl_pa.ragged_paged_attention(
+            q, k_pages, v_pages, page_table, positions, lengths,
             page_size=page_size, scale=scale,
             pages_per_block=pages_per_block,
         )

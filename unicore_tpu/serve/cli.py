@@ -64,6 +64,16 @@ def make_parser():
     eng.add_argument("--num-pages", type=int, default=64)
     eng.add_argument("--max-batch", type=int, default=8)
     eng.add_argument("--prefill-token-budget", type=int, default=512)
+    eng.add_argument("--prefill-chunk", type=int, default=0,
+                     help="ragged-step prefill chunk width: a prompt is "
+                          "admitted in slices of at most this many "
+                          "tokens per step (bounded TTFT under heavy "
+                          "admission; 0 = auto)")
+    eng.add_argument("--prefix-cache", choices=("on", "off"),
+                     default="on",
+                     help="shared-prefix KV page dedup: a repeat of a "
+                          "warm system prompt becomes a page-table "
+                          "lookup instead of a prefill (default: on)")
     flt = p.add_argument_group("fleet (docs/serving.md#fleet)")
     flt.add_argument("--fleet", action="store_true",
                      help="route through a FleetRouter over --replicas "
@@ -229,6 +239,8 @@ def _fleet_main(args, model, params, requests, shutdown):
             model, params, num_pages=args.num_pages,
             page_size=args.page_size, max_batch=args.max_batch,
             prefill_token_budget=args.prefill_token_budget,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache=args.prefix_cache == "on",
             max_waiting=args.max_waiting,
             request_retries=args.request_retries,
             drain_timeout=args.drain_timeout,
@@ -331,6 +343,8 @@ def main(argv=None):
         model, params, num_pages=args.num_pages, page_size=args.page_size,
         max_batch=args.max_batch,
         prefill_token_budget=args.prefill_token_budget,
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache=args.prefix_cache == "on",
         max_waiting=args.max_waiting,
         request_retries=args.request_retries,
         drain_timeout=args.drain_timeout, shutdown=shutdown,

@@ -1,38 +1,52 @@
 """ServeEngine: batched offline/online generation over the paged pool.
 
-The engine owns the device side of serving: ONE decode-step executable
-(fixed ``max_batch`` rows, inactive rows masked through the trash page)
-and one prefill executable per pow2 prompt bucket, both jitted with the
-pool buffers DONATED — after warmup every step updates the KV pool
-in-place and allocates nothing.  Sampling (greedy/temperature/top-k,
-seeded per request) runs inside the step, so only the [B] sampled token
-ids cross the host boundary each iteration; the host loop needs them
-anyway to drive the scheduler.
+The engine owns the device side of serving, and since the ragged
+unification that side is ONE step function: every batch row carries
+per-sequence ``(start, len, decode?)`` metadata — a decode row holds a
+single token, a prefill row holds a CHUNK of its prompt — and both run
+in the same compiled program ("Ragged Paged Attention", arxiv
+2604.15464).  The old per-pow2-bucket prefill family plus the separate
+decode jit collapse to a constant two lowerings of that one function:
+the ``width=1`` pure-decode dispatch (steady-state traffic pays no
+chunk padding) and the ``width=prefill_chunk`` mixed dispatch, so a
+long prompt is admitted in bounded-TTFT slices WHILE the running batch
+keeps decoding in the same dispatch.  UL205 audits that the program
+count stays constant over every prompt length.  Pool buffers are
+DONATED through every step — after warmup nothing reallocates — and
+sampling (greedy/temperature/top-k, seeded per request) runs inside the
+step, so only the [B] sampled token ids cross the host boundary.
+
+The pool itself is MULTI-TENANT: ``kv_pool.py`` dedups shared prefixes
+by chain-hash — a repeat of a warm system prompt becomes a page-table
+lookup instead of a prefill (``prefix_cache=True``), with the partial
+tail page always privately owned (copy-on-write by recompute), so one
+session's decode never mutates another's shared page.
 
 Metrics: per-request TTFT, aggregate decode tokens/sec, pool occupancy
-(peak + per-step into ``unicore_tpu.metrics`` when an aggregation
-context is active).
+and prefix-cache hit stats (peak + per-step into
+``unicore_tpu.metrics`` when an aggregation context is active).
 
 Robustness (ISSUE 7), layered on the ``resilience/`` machinery:
 
-- **Per-request fault isolation.**  Every jitted step also returns a
+- **Per-request fault isolation.**  Every ragged step also returns a
   per-row finite-logits flag (:func:`~unicore_tpu.serve.sampling.
   finite_rows` — the anomaly-guard pattern applied per request); a
   poisoned row is QUARANTINED: it finishes ``"failed"``, its pages are
-  freed, and the rest of the batch continues token-identically.  A
-  host-side step exception (sampler fault, bad assembly) likewise
-  fails only the in-flight sequences — the engine survives unless the
-  fault consumed the donated pool buffers.
+  freed (shared prefix pages just drop one reference — survivors
+  sharing them are untouched), and the rest of the batch continues
+  token-identically.  A host-side step exception (sampler fault, bad
+  assembly) likewise fails only the in-flight sequences — the engine
+  survives unless the fault consumed the donated pool buffers.
 - **Graceful drain.**  Wire a :class:`~unicore_tpu.resilience.
   preemption.GracefulShutdown` in (or call :meth:`request_drain`):
   admission closes at the next step boundary, waiting requests are
-  shed, running ones get ``drain_timeout`` seconds to finish before
-  they are shed too, and :attr:`drain_report` records the outcome —
-  the pool ends idle, nothing leaks.
+  shed, running ones get ``drain_timeout`` seconds to finish, and
+  :attr:`drain_report` records the outcome — the pool ends idle (a
+  warm prefix cache counts as idle), nothing leaks.
 - **Watchdog.**  ``step_timeout > 0`` arms a
   :class:`~unicore_tpu.resilience.watchdog.StepWatchdog` around every
-  prefill/decode dispatch, with a context hook naming the stuck phase
-  and the queue depths before the process exits.
+  ragged dispatch, with a context hook naming the stuck phase and the
+  queue depths before the process exits.
 - **Capacity fail-fast.**  A request whose prompt+generated prefix can
   never fit the pool terminates with reason ``"capacity"`` instead of
   cycling the preempt-retry recovery forever.
@@ -41,11 +55,11 @@ Fleet-facing API (ISSUE 11): the run loop is incrementally steppable so
 a router can interleave N replicas on one thread — :meth:`submit`
 enqueues, :meth:`serve_step` advances ONE scheduler iteration,
 :meth:`collect_finished` drains results, :meth:`load_snapshot` is the
-cheap typed health/load snapshot the router polls at admission, and
-:meth:`reclaim_waiting`/:meth:`reopen` are the rolling-restart hooks
-(waiting sequences hold no pool pages, so rerouting them drops
-nothing).  :meth:`generate` is now a thin driver over the same pieces,
-so solo-engine and fleet behavior cannot diverge.
+cheap typed health/load snapshot the router polls at admission (now
+carrying prefix-cache hit stats, so a router can see affinity paying
+off), and :meth:`reclaim_waiting`/:meth:`reopen` are the
+rolling-restart hooks.  :meth:`generate` is a thin driver over the
+same pieces, so solo-engine and fleet behavior cannot diverge.
 """
 
 import contextlib
@@ -82,14 +96,7 @@ class ServeResult:
     evictions: int
 
 
-PREFILL_BUCKET_FLOOR = 8
-
-
-def _pow2_bucket(n, floor=PREFILL_BUCKET_FLOOR):
-    b = floor
-    while b < n:
-        b *= 2
-    return b
+DEFAULT_PREFILL_CHUNK = 32
 
 
 class ServeEngine:
@@ -102,6 +109,7 @@ class ServeEngine:
 
     def __init__(self, model, params, *, num_pages=64, page_size=16,
                  max_batch=8, prefill_token_budget=512, max_context=None,
+                 prefill_chunk=0, prefix_cache=True, unified=True,
                  chaos_rate=0.0, chaos_rng=None, max_waiting=None,
                  request_retries=DEFAULT_REQUEST_RETRIES,
                  drain_timeout=30.0, shutdown=None, step_timeout=0.0,
@@ -117,8 +125,13 @@ class ServeEngine:
             int(max_context or model.max_seq_len), model.max_seq_len, cap
         )
         self.num_slots = self.num_pages * self.page_size
-        self.pool = PagedKVPool(self.num_pages, self.page_size)
+        self.pool = PagedKVPool(self.num_pages, self.page_size,
+                                prefix_cache=prefix_cache)
         self.table_width = self.pool.pages_for(self.max_context)
+        # unified=False is the bench A/B baseline: prefill rows and
+        # decode rows dispatch as two separate programs per step (the
+        # old split-program behavior) instead of one mixed dispatch
+        self.unified = bool(unified)
         self.scheduler = Scheduler(
             self.pool, self.max_batch,
             prefill_token_budget=self.prefill_token_budget,
@@ -126,12 +139,23 @@ class ServeEngine:
             max_waiting=max_waiting, request_retries=request_retries,
         )
         self.pages = self._init_pages()
-        # the prompt-length -> compile-bucket map, overridable so the
+        # prefill-chunk width: a prompt is admitted in <= this many
+        # tokens per ragged step (bounded-TTFT slices).  0 = auto: the
+        # default, unless the autotuner measured a chunked-admission
+        # candidate winning for this engine's bucket (the pool leaves
+        # carry the heads/head-dim the workload key needs)
+        chunk = int(prefill_chunk)
+        if not chunk:
+            chunk = DEFAULT_PREFILL_CHUNK
+            tuned = self._tuned_chunk(chunk)
+            if tuned:
+                chunk = tuned
+        self.prefill_chunk = max(1, min(chunk, self.max_context))
+        # the chunk-size -> compiled-width map, overridable so the
         # static audit (analysis/hlo_audit.py UL205) can check that it
-        # never produces a lowering outside prefill_buckets()
-        self.bucket_fn = _pow2_bucket
-        self._prefill_fns = {}
-        self._decode_fns = {}
+        # never produces a lowering outside serve_step_widths()
+        self.width_fn = self._width_for
+        self._step_fns = {}
         # one host clock for enqueue stamps, TTFT, deadlines, and the
         # drain timer — injectable so deadline/drain tests are exact
         self._clock = clock or time.perf_counter
@@ -173,6 +197,7 @@ class ServeEngine:
             "pool_exhausted_recoveries": 0,
             "shed": 0, "expired": 0, "quarantined": 0, "host_faults": 0,
             "capacity_failfast": 0, "peak_waiting": 0,
+            "prefix_hits": 0, "prefix_tokens_saved": 0,
         }
 
     # -- pool buffers --------------------------------------------------
@@ -199,7 +224,27 @@ class ServeEngine:
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
 
-    # -- jitted steps --------------------------------------------------
+    def _tuned_chunk(self, default_chunk):
+        """Measured prefill-chunk verdict for this engine's ragged-step
+        bucket (a ``{"prefill_chunk": c}`` candidate that beat the
+        full-width dispatch when the bucket was tuned).  Lookup-only
+        and fail-open: a missing cache, an unexpected pool layout, or
+        any tuner error just keeps the default."""
+        try:
+            from unicore_tpu.ops import tuning
+
+            leaf = jax.tree_util.tree_leaves(self.pages)[0]
+            return tuning.tuned_prefill_chunk(tuning.ragged_paged_decision(
+                (self.max_batch, default_chunk,
+                 leaf.shape[1], leaf.shape[2]),
+                self.table_width, self.page_size, leaf.dtype.name,
+            ), default_chunk)
+        except Exception as e:  # noqa: BLE001 - fail open to the default
+            logger.debug("tuned prefill-chunk lookup failed (%s); "
+                         "using the default", e)
+            return None
+
+    # -- the one jitted step -------------------------------------------
 
     @staticmethod
     def _pick_tokens(logits, seeds, steps, temperature, top_k, sampling):
@@ -226,15 +271,38 @@ class ServeEngine:
             return "temp"
         return "greedy"
 
-    def _decode_step_fn(self, sampling):
-        fn = self._decode_fns.get(sampling)
+    def _width_for(self, chunk):
+        """Compiled width for a step whose widest row carries ``chunk``
+        tokens: the pure-decode width-1 program when every row is a
+        single token, the prefill-chunk program otherwise.  The
+        compile surface is CONSTANT — two lowerings per sampling
+        variant, independent of prompt length (the UL205 contract)."""
+        return 1 if chunk <= 1 else self.prefill_chunk
+
+    def serve_step_widths(self):
+        """The declared compile surface: every ragged-step width
+        ``width_fn`` may produce.  ``trace_step_fns`` traces one
+        executable per entry, and UL205 fails when ``width_fn`` can
+        produce a width outside this set."""
+        if self.prefill_chunk == 1:
+            return (1,)
+        return (1, self.prefill_chunk)
+
+    def _ragged_step_fn(self, width, sampling):
+        """The unified serve step at one static width: rows carry
+        (tokens, positions, slot_mapping, lengths) per-sequence ragged
+        metadata — a decode row has one real token, a prefill row a
+        chunk; padded columns sit at position -1 writing the trash
+        slot.  Each row samples from its LAST real column's logits."""
+        key = (width, sampling)
+        fn = self._step_fns.get(key)
         if fn is None:
             model, page_size = self.model, self.page_size
             poison_gate = self._chaos_poison
 
             def step(params, pages, tokens, positions, page_table,
-                     slot_mapping, lengths, seeds, steps, temperature,
-                     top_k, poison=None):
+                     slot_mapping, lengths, last_col, seeds, steps,
+                     temperature, top_k, poison=None):
                 meta = PagedMeta(
                     page_table=page_table, slot_mapping=slot_mapping,
                     lengths=lengths, page_size=page_size,
@@ -244,7 +312,12 @@ class ServeEngine:
                     decode=True, positions=positions, paged=meta,
                     mutable=["pagedkv"],
                 )
-                rows = logits[:, -1]
+                # each row's sampled-from logits: the last REAL column
+                # of its chunk (a decode row: its single token; a
+                # prefill tail chunk: the final prompt token)
+                rows = jnp.take_along_axis(
+                    logits, last_col[:, None, None], axis=1
+                )[:, 0]
                 if poison_gate:  # chaos injection, gated at trace time
                     rows = jnp.where(
                         poison[:, None], jnp.asarray(jnp.nan, rows.dtype),
@@ -256,65 +329,14 @@ class ServeEngine:
                 )
                 return toks, ok, mutated["pagedkv"]
 
-            fn = self._decode_fns[sampling] = jax.jit(
-                step, donate_argnums=(1,)
-            )
-        return fn
-
-    def _prefill_fn(self, bucket, sampling):
-        key = (bucket, sampling)
-        fn = self._prefill_fns.get(key)
-        if fn is None:
-            model, page_size = self.model, self.page_size
-            poison_gate = self._chaos_poison
-
-            def step(params, pages, tokens, positions, page_table,
-                     slot_mapping, lengths, seeds, steps, temperature,
-                     top_k, poison=None):
-                meta = PagedMeta(
-                    page_table=page_table, slot_mapping=slot_mapping,
-                    lengths=lengths, page_size=page_size,
-                )
-                logits, mutated = model.apply(
-                    {"params": params, "pagedkv": pages}, tokens,
-                    decode=True, positions=positions, paged=meta,
-                    mutable=["pagedkv"],
-                )
-                # first token comes from the LAST VALID prompt row
-                last = logits[0, lengths[0] - 1][None]
-                if poison_gate:  # chaos injection, gated at trace time
-                    last = jnp.where(
-                        poison[:, None], jnp.asarray(jnp.nan, last.dtype),
-                        last,
-                    )
-                ok = finite_rows(last)
-                toks = self._pick_tokens(
-                    last, seeds, steps, temperature, top_k, sampling
-                )
-                return toks, ok, mutated["pagedkv"]
-
-            fn = self._prefill_fns[key] = jax.jit(
+            fn = self._step_fns[key] = jax.jit(
                 step, donate_argnums=(1,)
             )
         return fn
 
     # -- static-audit surface ------------------------------------------
 
-    def prefill_buckets(self):
-        """The declared prefill compile surface: the pow2 bucket chain
-        covering every admissible prompt length.  ``trace_step_fns``
-        traces one executable per entry, and UL205 fails when
-        ``bucket_fn`` can produce a bucket outside this set."""
-        out = []
-        b = PREFILL_BUCKET_FLOOR
-        while True:
-            out.append(b)
-            if b >= self.max_context:
-                break
-            b *= 2
-        return tuple(out)
-
-    def trace_step_fns(self, *, sampling="greedy", buckets=None):
+    def trace_step_fns(self, *, sampling="greedy", widths=None):
         """AOT trace + lower every serve executable WITHOUT executing.
 
         The static-analysis subsystem audits the returned artifacts
@@ -323,7 +345,7 @@ class ServeEngine:
         coverage, and the lowered module for the Pass-3 compiled-HLO
         audit.  All step inputs are ShapeDtypeStructs — nothing touches
         a device — and the traced jit objects are the SAME cached
-        closures ``generate()`` dispatches through, so the audit sees
+        closures ``serve_step`` dispatches through, so the audit sees
         the program that serves."""
         import jax
 
@@ -336,26 +358,20 @@ class ServeEngine:
             return jax.ShapeDtypeStruct(shape, dtype)
 
         params, pages = sds(self.params), sds(self.pages)
-        W = self.table_width
+        B, W = self.max_batch, self.table_width
         arts = {}
-        buckets = self.prefill_buckets() if buckets is None else buckets
-        for b in buckets:
-            extra = ((s(1, dtype=jnp.bool_),) if self._chaos_poison
+        widths = self.serve_step_widths() if widths is None else widths
+        for w in widths:
+            extra = ((s(B, dtype=jnp.bool_),) if self._chaos_poison
                      else ())
-            traced = self._prefill_fn(b, sampling).trace(
-                params, pages, s(1, b), s(1, b), s(1, W), s(b), s(1),
-                s(1), s(1), s(1, dtype=jnp.float32), s(1), *extra,
+            traced = self._ragged_step_fn(w, sampling).trace(
+                params, pages, s(B, w), s(B, w), s(B, W), s(B * w),
+                s(B), s(B), s(B), s(B), s(B, dtype=jnp.float32), s(B),
+                *extra,
             )
-            arts[f"prefill-b{b}"] = {
+            arts[f"ragged-w{w}"] = {
                 "jaxpr": traced.jaxpr, "lowered": traced.lower(),
             }
-        B = self.max_batch
-        extra = ((s(B, dtype=jnp.bool_),) if self._chaos_poison else ())
-        traced = self._decode_step_fn(sampling).trace(
-            params, pages, s(B, 1), s(B, 1), s(B, W), s(B), s(B), s(B),
-            s(B), s(B, dtype=jnp.float32), s(B), *extra,
-        )
-        arts["decode"] = {"jaxpr": traced.jaxpr, "lowered": traced.lower()}
         return arts
 
     # -- host-side step assembly ---------------------------------------
@@ -383,7 +399,8 @@ class ServeEngine:
 
     def _quarantine(self, seq, phase):
         """Retire one poisoned-row sequence: reason ``"failed"``, pages
-        freed, batch untouched."""
+        freed (shared prefix pages drop one reference — survivors
+        sharing the prefix keep theirs), batch untouched."""
         logger.warning(
             "quarantined request %r after a nonfinite logits row in %s "
             "(%d tokens emitted so far); the rest of the batch continues",
@@ -393,101 +410,163 @@ class ServeEngine:
         self.stats["quarantined"] += 1
         metrics.log_scalar("serve/quarantined", self.stats["quarantined"])
 
-    def _padded_table(self, seq):
-        table = np.zeros((self.table_width,), np.int32)
-        pages = self.pool.page_table(seq.sid)
-        table[: len(pages)] = pages
-        return table
+    @staticmethod
+    def _is_decode_ready(seq):
+        """A sequence whose only missing KV is its newest generated
+        token (steady-state decode) vs one still advancing prefill."""
+        return (bool(seq.generated)
+                and seq.prefilled == len(seq.prefix()) - 1)
 
-    def _prefill(self, seq):
-        prefix = seq.prefix()
-        n = len(prefix)
-        bucket = self.bucket_fn(n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = prefix
-        positions = np.full((1, bucket), -1, np.int32)
-        positions[0, :n] = np.arange(n)
-        slot_mapping = np.zeros((bucket,), np.int32)
-        for r in range(n):
-            slot_mapping[r] = self.pool.slot(seq.sid, r)
-        req = seq.req
-        args = [
-            self.params, self.pages,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self._padded_table(seq)[None]),
-            jnp.asarray(slot_mapping),
-            jnp.asarray([n], jnp.int32),
-            jnp.asarray([req.seed], jnp.int32),
-            jnp.asarray([len(seq.generated)], jnp.int32),
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-        ]
-        if self._chaos_poison:
-            args.append(jnp.asarray([self._poison_row(seq)]))
-        with self._armed(f"serve/prefill-b{bucket}"):
-            tok, ok, self.pages = self._prefill_fn(
-                bucket, self._sampling_mode([seq]))(*args)
-            ok = np.asarray(ok)  # host sync: termination needs it
-            tok = np.asarray(tok)
-        self.stats["prefills"] += 1
-        if not bool(ok[0]):
-            self._quarantine(seq, f"prefill-b{bucket}")
-            return
-        self._emit(seq, int(tok[0]))
+    def _plan_rows(self, seqs):
+        """Assign this step's batch rows: ``[(seq, start, m, emit,
+        is_decode), ...]``, at most ``max_batch`` of them.
 
-    def _decode(self, seqs):
+        Decode-ready sequences take their single-token rows first (a
+        running decode is never delayed by admission), then LEFTOVER
+        row capacity soaks prompt chunks — one span per prefilling
+        sequence in admission order, then EXTRA spans of the same
+        prompts.  Packing several consecutive chunks of ONE prompt
+        into several rows of one dispatch is sound because every
+        layer's KV scatter lands before its gather: chunk k's queries
+        see chunk j<k's keys written in the same program, exactly as a
+        single full-length prefill would — so a cold solo prompt fills
+        the whole ``max_batch x prefill_chunk`` token budget instead
+        of paying for one ragged row and B-1 padded ones."""
+        rows = []
+        prefilling = []
+        for seq in seqs:
+            if self._is_decode_ready(seq):
+                rows.append((seq, seq.prefilled, 1, True, True))
+            else:
+                prefilling.append([seq, seq.prefilled])
+        while prefilling and len(rows) < self.max_batch:
+            for entry in list(prefilling):
+                if len(rows) >= self.max_batch:
+                    break
+                seq, start = entry
+                total = len(seq.prefix())
+                m = min(self.prefill_chunk, total - start)
+                rows.append((seq, start, m, start + m == total, False))
+                entry[1] = start + m
+                if entry[1] >= total:
+                    prefilling.remove(entry)
+        return rows
+
+    def _dispatch(self, rows):
+        """ONE ragged step over planned ``rows`` (mixed prefill-chunk
+        and decode rows): build the per-row metadata, run the unified
+        compiled program, advance each sequence's prefill watermark,
+        emit or quarantine.
+
+        Row ASSEMBLY faults stay per-request: the host work most likely
+        to be poisoned by one bad request's state (slot lookups, prefix
+        indexing) runs in a per-row guard that fails only that
+        sequence — the unified dispatch must not widen a single
+        request's blast radius from 1 to ``max_batch`` (the per-seq
+        isolation the old split prefill path had).  Only a fault in the
+        compiled call itself still fails the whole in-flight batch."""
         B = self.max_batch
-        tokens = np.zeros((B, 1), np.int32)
-        positions = np.full((B, 1), -1, np.int32)
+        w = self.width_fn(max(m for _, _, m, _, _ in rows))
+        assert all(m <= w for _, _, m, _, _ in rows), (rows, w)
+        tokens = np.zeros((B, w), np.int32)
+        positions = np.full((B, w), -1, np.int32)
         tables = np.zeros((B, self.table_width), np.int32)
-        slot_mapping = np.zeros((B,), np.int32)
+        slot_mapping = np.zeros((B * w,), np.int32)  # 0 = trash slot
         lengths = np.zeros((B,), np.int32)
+        last_col = np.zeros((B,), np.int32)
         temperature = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         seeds = np.zeros((B,), np.int32)
         steps = np.zeros((B,), np.int32)
-        for b, seq in enumerate(seqs):
-            prefix = seq.prefix()
-            tokens[b, 0] = prefix[-1]
-            positions[b, 0] = len(prefix) - 1
-            tables[b] = self._padded_table(seq)
-            slot_mapping[b] = self.pool.slot(seq.sid, len(prefix) - 1)
-            lengths[b] = len(prefix)
-            temperature[b] = seq.req.temperature
-            top_k[b] = seq.req.top_k
-            seeds[b] = seq.req.seed
-            steps[b] = len(seq.generated)
-        sampling = self._sampling_mode(seqs)
+        packed = []
+        for seq, start, m, emit, dec in rows:
+            if seq.done:
+                continue  # failed through an earlier row this step
+            b = len(packed)
+            try:
+                prefix = seq.prefix()
+                ptable = np.asarray(self.pool.page_table(seq.sid),
+                                    np.int32)
+                pos = np.arange(start, start + m)
+                page_idx = pos // self.page_size
+                if page_idx[-1] >= len(ptable):
+                    raise IndexError(
+                        f"position {start + m - 1} beyond the "
+                        f"{len(ptable)} page(s) of sequence {seq.sid!r}"
+                    )
+                tokens[b, :m] = prefix[start:start + m]
+                positions[b, :m] = pos
+                tables[b, :len(ptable)] = ptable
+                # a chunk's write slots, vectorized: one table fetch per
+                # row instead of a per-token pool.slot() call
+                slot_mapping[b * w:b * w + m] = (
+                    ptable[page_idx] * self.page_size
+                    + pos % self.page_size
+                )
+                lengths[b] = start + m
+                last_col[b] = m - 1
+                temperature[b] = seq.req.temperature
+                top_k[b] = seq.req.top_k
+                seeds[b] = seq.req.seed
+                steps[b] = len(seq.generated)
+            except Exception as exc:  # noqa: BLE001 - per-row isolation
+                # scrub the half-written row (trash-slot defaults) and
+                # fail ONLY this sequence
+                tokens[b] = 0
+                positions[b] = -1
+                tables[b] = 0
+                slot_mapping[b * w:(b + 1) * w] = 0
+                lengths[b] = 0
+                self._host_fault([seq], "row-assembly", exc)
+                continue
+            packed.append((seq, start, m, emit, dec))
+        rows = packed
+        if not rows:
+            return
+        sampling = self._sampling_mode([r[0] for r in rows])
         args = [
             self.params, self.pages,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(slot_mapping),
-            jnp.asarray(lengths), jnp.asarray(seeds),
-            jnp.asarray(steps), jnp.asarray(temperature),
-            jnp.asarray(top_k),
+            jnp.asarray(lengths), jnp.asarray(last_col),
+            jnp.asarray(seeds), jnp.asarray(steps),
+            jnp.asarray(temperature), jnp.asarray(top_k),
         ]
         if self._chaos_poison:
             poison = np.zeros((B,), bool)
-            for b, seq in enumerate(seqs):
+            for b, (seq, *_rest) in enumerate(rows):
                 poison[b] = self._poison_row(seq)
             args.append(jnp.asarray(poison))
+        any_decode = any(r[4] for r in rows)
         t0 = time.perf_counter()
-        with self._armed("serve/decode"):
-            toks, ok, self.pages = self._decode_step_fn(sampling)(*args)
+        with self._armed(f"serve/ragged-w{w}"):
+            toks, ok, self.pages = self._ragged_step_fn(w, sampling)(*args)
             toks = np.asarray(toks)  # host sync: the scheduler needs them
             ok = np.asarray(ok)
         dt = time.perf_counter() - t0
-        self.stats["decode_time_s"] += dt
-        self.decode_ms.append(dt * 1e3)
-        self.stats["decode_steps"] += 1
-        self.stats["decode_tokens"] += len(seqs)
-        if self.progress_path:
-            with open(self.progress_path, "a") as fh:
-                fh.write(f"{self.stats['decode_steps']}\n")
-        for b, seq in enumerate(seqs):
+        self.stats["prefills"] += sum(1 for r in rows if not r[4])
+        if any_decode:
+            self.stats["decode_time_s"] += dt
+            self.decode_ms.append(dt * 1e3)
+            self.stats["decode_steps"] += 1
+            self.stats["decode_tokens"] += sum(1 for r in rows if r[4])
+            if self.progress_path:
+                with open(self.progress_path, "a") as fh:
+                    fh.write(f"{self.stats['decode_steps']}\n")
+        for b, (seq, start, m, emit, _) in enumerate(rows):
+            if seq.done:
+                continue  # quarantined through an earlier row this step
             if not bool(ok[b]):
-                self._quarantine(seq, "decode")
-            else:
+                self._quarantine(seq, f"ragged-w{w}")
+                continue
+            seq.prefilled = start + m  # rows per seq are ascending
+            if (not seq.prefix_registered
+                    and seq.prefilled >= len(seq.req.prompt)):
+                # the prompt's KV is fully written: index its full
+                # pages so later shared-prefix requests dedup
+                self.pool.register_prefix(seq.sid, seq.req.prompt)
+                seq.prefix_registered = True
+            if emit:
                 self._emit(seq, int(toks[b]))
 
     def _emit(self, seq, token):
@@ -635,6 +714,9 @@ class ServeEngine:
     def _sync_lifecycle_stats(self):
         self.stats["shed"] = self.scheduler.num_shed
         self.stats["expired"] = self.scheduler.num_expired
+        self.stats["prefix_hits"] = self.pool.prefix_stats["hits"]
+        self.stats["prefix_tokens_saved"] = (
+            self.pool.prefix_stats["tokens_saved"])
 
     def _fail_capacity(self, seq):
         """Satellite fix: a request whose prefix can never fit even an
@@ -687,13 +769,33 @@ class ServeEngine:
     def has_work(self):
         return self.scheduler.has_work()
 
+    def _step_rows(self, todo):
+        """Dispatch this step's planned rows.  Unified (production):
+        ONE mixed ragged dispatch.  Split (``unified=False``, the
+        bench A/B baseline): prefill rows and decode rows run as two
+        separate programs — the old two-program shape, expressed
+        through the same machinery so the comparison isolates the
+        unification."""
+        rows = self._plan_rows(todo)
+        if not rows:
+            return
+        if self.unified:
+            self._dispatch(rows)
+            return
+        for group in ([r for r in rows if not r[4]],
+                      [r for r in rows if r[4]]):
+            live = [r for r in group
+                    if r[0] in self.scheduler.running and not r[0].done]
+            if live:
+                self._dispatch(live)
+
     def serve_step(self):
         """Advance the engine by ONE scheduler iteration: deadline
-        expiry, drain bookkeeping, capacity fail-fast, admission +
-        prefill, one decode dispatch.  Returns True while work remains
-        queued — the fleet router's interleaving unit (and what
-        ``generate()`` loops on).  An idle call is cheap and finalizes
-        a pending drain report."""
+        expiry, drain bookkeeping, capacity fail-fast, admission, one
+        ragged dispatch (mixed prefill-chunk + decode rows).  Returns
+        True while work remains queued — the fleet router's
+        interleaving unit (and what ``generate()`` loops on).  An idle
+        call is cheap and finalizes a pending drain report."""
         sched = self.scheduler
         if not sched.has_work():
             self._sync_lifecycle_stats()
@@ -733,7 +835,7 @@ class ServeEngine:
             self._stalled = 0
             return False
         failed_fast = 0
-        admitted, did_decode = [], False
+        admitted, did_dispatch = [], False
         try:
             # capacity fail-fast BEFORE admission: a head request
             # that can never fit would otherwise stall the queue
@@ -745,24 +847,21 @@ class ServeEngine:
                 failed_fast += 1
             if not self._draining:
                 # admit() hands back fresh AND resumed sequences —
-                # a resumed one re-prefills prompt+generated,
-                # recreating exactly the KV its eviction dropped
-                admitted = sched.admit(bucket=self.bucket_fn)
-            for seq in admitted:
-                try:
-                    self._prefill(seq)
-                except Exception as exc:  # host fault isolation
-                    self._host_fault([seq], "prefill", exc)
+                # their ragged prefill starts past any shared-prefix
+                # pages the pool matched (a resumed one re-creates
+                # exactly the KV its eviction dropped)
+                admitted = sched.admit(
+                    bucket=lambda n: min(n, self.prefill_chunk))
             if not self._draining:
                 sched.chaos_preempt()
             if sched.running:
                 todo = sched.prepare_decode()
                 if todo:
                     try:
-                        self._decode(todo)
+                        self._step_rows(todo)
                     except Exception as exc:  # host fault isolation
-                        self._host_fault(todo, "decode", exc)
-                    did_decode = True
+                        self._host_fault(todo, "ragged-step", exc)
+                    did_dispatch = True
             # deadline expiry at the DECODE boundary: pages free
             # the moment the deadline blows, not a decode tail later
             expired = bool(sched.expire(self._clock())) or expired
@@ -804,7 +903,7 @@ class ServeEngine:
         # that drained the batch): the freed pages guarantee the
         # NEXT iteration admits.  Two empty iterations in a row
         # cannot happen unless the scheduler is genuinely wedged.
-        progressed = bool(admitted or did_decode or expired
+        progressed = bool(admitted or did_dispatch or expired
                           or failed_fast or shed_now)
         self._stalled = 0 if progressed else self._stalled + 1
         if self._stalled >= 2 and sched.has_work():
@@ -855,17 +954,24 @@ class ServeEngine:
         dict (tests pin the keys and types; routers across versions
         depend on them):
 
-        ``free_pages``/``total_pages`` (int) pool headroom,
-        ``waiting``/``running`` (int) queue depths, ``free_slots``
-        (int) open decode-batch rows, ``max_waiting`` (int or None)
-        the bounded-queue shed line, ``draining`` (bool) admission
-        closed (flag set or a wired shutdown requested), ``step_ms``
-        (float) median of the recent decode-step wall latencies (0.0
-        until the first decode) — what the router multiplies queue
-        depth by to project a request's wait against its deadline."""
+        ``free_pages``/``total_pages`` (int) pool headroom (cached
+        prefix pages count as free), ``waiting``/``running`` (int)
+        queue depths, ``free_slots`` (int) open decode-batch rows,
+        ``max_waiting`` (int or None) the bounded-queue shed line,
+        ``draining`` (bool) admission closed (flag set or a wired
+        shutdown requested), ``step_ms`` (float) median of the recent
+        decode-step wall latencies (0.0 until the first decode) — what
+        the router multiplies queue depth by to project a request's
+        wait against its deadline — and the prefix-cache hit surface:
+        ``prefix_hits`` (int), ``prefix_tokens_saved`` (int),
+        ``prefix_hit_rate`` (float, hits/lookups, 0.0 before the first
+        lookup) — how much the router's session affinity is paying
+        off on this replica."""
         sched = self.scheduler
         recent = list(self.decode_ms)[-33:]
         step_ms = float(sorted(recent)[len(recent) // 2]) if recent else 0.0
+        ps = self.pool.prefix_stats
+        hit_rate = (ps["hits"] / ps["lookups"]) if ps["lookups"] else 0.0
         return {
             "free_pages": int(self.pool.num_free_pages),
             "total_pages": int(self.pool.num_usable_pages),
@@ -876,6 +982,9 @@ class ServeEngine:
                             else int(sched.max_waiting)),
             "draining": bool(self._draining or self._drain_requested()),
             "step_ms": round(step_ms, 4),
+            "prefix_hits": int(ps["hits"]),
+            "prefix_tokens_saved": int(ps["tokens_saved"]),
+            "prefix_hit_rate": round(float(hit_rate), 4),
         }
 
     def reclaim_waiting(self):

@@ -12,10 +12,14 @@ decode steps under a token budget):
 
 - Each engine step first ADMITS waiting requests — newest-request-last —
   while there is a free decode slot, the pool can hold the prompt's
-  pages, and the step's prefill-token budget is not exhausted (the
-  budget caps time-to-first-token jitter for already-running requests;
-  a prompt longer than the whole budget is admitted alone rather than
-  starved).  Then every running sequence takes one decode step.
+  pages (shared-prefix pages are credited: the pool dedups them by
+  reference), and the step's prefill-token budget is not exhausted
+  (cost = the request's first ragged chunk, so the budget caps
+  concurrent prefill width; a prompt longer than the whole budget is
+  admitted alone rather than starved).  Then every running sequence
+  takes a row in the engine's unified ragged dispatch — decode-ready
+  sequences a single-token row, prefilling ones a chunk of their
+  prompt.
 - Pool exhaustion when a sequence crosses a page boundary PREEMPTS the
   most recently admitted running sequence (LIFO victim: it has the
   least sunk decode work).  Preemption frees the pages and requeues the
@@ -92,6 +96,13 @@ class Sequence:
         self.enqueued_at = None  # host clocks are the engine's job
         self.first_token_at = None
         self.finish_reason = None
+        # tokens whose KV is already written to pool pages (set to the
+        # pool's shared-prefix credit at admission; the engine advances
+        # it one ragged chunk per step — a sequence is decode-ready when
+        # prefilled == len(prefix()) - no missing KV but the newest
+        # token's)
+        self.prefilled = 0
+        self.prefix_registered = False
 
     def prefix(self):
         """Tokens whose KV must be live before the next decode step can
@@ -208,9 +219,11 @@ class Scheduler:
 
     def admit(self, bucket=None):
         """Admit waiting sequences for prefill this step (allocating
-        their pool pages).  ``bucket``: maps a prompt length to the
-        padded prefill length actually traced (budget accounting uses
-        it).  Returns the admitted sequences in admission order."""
+        their pool pages, shared-prefix pages by reference).
+        ``bucket``: maps a prefix length to this step's admission cost
+        in prefill tokens (the engine passes its first-chunk size, so
+        the budget caps concurrent prefill width, not total prompt
+        length).  Returns the admitted sequences in admission order."""
         bucket = bucket or (lambda n: n)
         admitted = []
         budget = self.prefill_token_budget
@@ -219,7 +232,8 @@ class Scheduler:
             cost = bucket(len(seq.prefix()))
             if admitted and cost > budget:
                 break
-            if not self.pool.can_alloc(len(seq.prefix())):
+            if not self.pool.can_alloc(len(seq.prefix()),
+                                       tokens=seq.prefix()):
                 break
             # alloc BEFORE popping: if the pool raises anyway (an
             # admission race the can_alloc check missed), the sequence
@@ -232,11 +246,15 @@ class Scheduler:
             # preempt-a-victim-and-retry recovery — so a PoolExhausted
             # escaping admit() guarantees no half-admitted state.
             try:
-                self.pool.alloc(seq.sid, len(seq.prefix()))
+                self.pool.alloc(seq.sid, len(seq.prefix()),
+                                tokens=seq.prefix())
             except PoolExhausted:
                 if admitted:
                     break
                 raise
+            # shared-prefix credit: the matched pages' KV already
+            # exists, so the ragged prefill starts past them
+            seq.prefilled = self.pool.cached_tokens(seq.sid)
             self.waiting.popleft()
             self.running.append(seq)
             admitted.append(seq)
@@ -260,15 +278,21 @@ class Scheduler:
         return None
 
     def prepare_decode(self):
-        """Grow every running sequence's pool length by one (the token
-        the next decode step writes), evicting LIFO on exhaustion.
-        Returns the sequences that will decode this step."""
+        """Grow every running sequence's pool length to cover its
+        current prefix (a decode-ready sequence grows by one — the
+        token this step writes; a mid-prefill sequence is already
+        covered by its admission alloc), evicting LIFO on exhaustion.
+        Returns the sequences that take a row in this step's ragged
+        dispatch."""
         for seq in list(self.running):
             if seq not in self.running:
                 continue  # evicted by an earlier iteration
             while True:
+                grow = len(seq.prefix()) - self.pool.seq_len(seq.sid)
+                if grow <= 0:
+                    break
                 try:
-                    self.pool.extend(seq.sid, 1)
+                    self.pool.extend(seq.sid, grow)
                     break
                 except PoolExhausted:
                     victim = self._pick_victim()
@@ -295,10 +319,13 @@ class Scheduler:
         """Free the sequence's pages and requeue it (front: it keeps its
         age priority).  Its generated tokens stay with it — nothing is
         lost, and re-prefilling prompt+generated re-creates exactly the
-        KV state the eviction dropped."""
+        KV state the eviction dropped (a warm prefix cache turns most of
+        that re-prefill back into a page-table lookup)."""
         self.pool.free(seq.sid)
         self.running.remove(seq)
         self.waiting.appendleft(seq)
+        seq.prefilled = 0
+        seq.prefix_registered = False
         seq.evictions += 1
         self.num_evictions += 1
 
