@@ -626,6 +626,89 @@ def _fleet_slo_micros(out):
     return round(agg["shed"] / len(trace), 4)
 
 
+def _fleet_failover_micros(out):
+    """Failover recovery cost (ISSUE 14): a warm 2-replica fleet
+    replays the COMMITTED trace (``FLEET_TRACE_SEED``) and replica r0
+    is KILLED mid-replay (its serve_step raises — the crash shape the
+    router's guarded step loop turns into an eviction + re-dispatch).
+
+    - ``fleet_failover_recovery_ms``: wall duration of the ONE fleet
+      step that detects the crash, evicts the replica off the ring,
+      and re-dispatches every salvaged session to the survivor — the
+      router-side cost of a replica death (the salvaged re-prefill
+      itself then amortizes over the following steps).
+    - ``fleet_failover_ttft_p99_ms``: p99 TTFT over the whole
+      killed-replica replay — the failover-induced tail, read against
+      the undisturbed ``fleet_ttft_p99_ms`` from the same trace."""
+    import numpy as np
+
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import generate_trace
+    from unicore_tpu.serve.scheduler import Request
+
+    engines = {}
+    for rid in ("r0", "r1"):
+        _, engines[rid] = _serve_engine(max_waiting=16)
+    for eng in engines.values():
+        eng.generate([
+            Request(prompt=list(range(1, n + 1)), max_new_tokens=2,
+                    seed=0)
+            for n in (8, 16, 32, 64)
+        ])
+        eng.collect_finished()
+    router = FleetRouter(engines)
+    trace = generate_trace(
+        FLEET_TRACE_SEED, num_requests=64, sessions=8,
+        vocab=4096, body_len_clip=(1, 48), max_new_tokens=(4, 12),
+    )
+    kill_step = 6
+    # replay_trace's virtual-clock loop, inlined so the eviction
+    # step's wall duration is individually measurable
+    pending = sorted(trace,
+                     key=lambda e: (e.at_ms, e.request.request_id))
+    now, steps, i = 0.0, 0, 0
+    recovery_ms = None
+    while i < len(pending) or router.has_work():
+        while i < len(pending) and pending[i].at_ms <= now:
+            ev = pending[i]
+            router.submit(ev.request, session_key=ev.session)
+            i += 1
+        if i < len(pending) and not router.has_work():
+            now = max(now, pending[i].at_ms)
+            continue
+        if steps == kill_step and "r0" in router.engines:
+            def _boom():
+                raise RuntimeError("bench: replica r0 killed")
+
+            router.engines["r0"].serve_step = _boom
+        lost0 = router.stats["replicas_lost"]
+        t0 = time.perf_counter()
+        router.step()
+        dt = time.perf_counter() - t0
+        if router.stats["replicas_lost"] > lost0:
+            recovery_ms = dt * 1e3
+        now += 2.0
+        steps += 1
+        assert steps < 200000, "failover bench wedged"
+    router.collect()
+    results = router.results()
+    assert recovery_ms is not None, "the bench kill never landed"
+    assert (router.stats["replicas_lost"] == 1
+            and router.stats["failovers"] >= 1), router.stats
+    assert router.stats["replica_lost"] == 0, (
+        "requests terminated replica_lost below the failover budget")
+    assert len(results) == len(trace), (
+        f"failover bench dropped requests: {len(results)}/{len(trace)}")
+    ttfts = sorted(r.ttft_ms for r in results.values()
+                   if r.ttft_ms is not None)
+    out["fleet_failover_ttft_p99_ms"] = round(
+        float(np.percentile(ttfts, 99)), 2)
+    out["fleet_failover_kill_step"] = kill_step
+    out["fleet_failover_failovers"] = router.stats["failovers"]
+    out["fleet_failover_trace_seed"] = FLEET_TRACE_SEED
+    return round(recovery_ms, 2)
+
+
 def _host_overlap_micros(out):
     """Step-boundary host time + checkpoint save stall, async vs sync
     (ISSUE 6), on the shrunk 2x64 trainer — the numbers isolate the
@@ -1176,6 +1259,11 @@ def _microbench(out):
     _micro_guard(out, "fleet_shed_rate",
                  lambda: _fleet_slo_micros(out))
 
+    # fleet failover (ISSUE 14): kill 1 of 2 replicas mid-replay of the
+    # committed trace — eviction+re-dispatch cost and the TTFT tail
+    _micro_guard(out, "fleet_failover_recovery_ms",
+                 lambda: _fleet_failover_micros(out))
+
     # step-boundary overlap (ISSUE 6): top-level helper, shared with
     # the BENCH_CPU_TIER entry point
     _micro_guard(out, "step_boundary_host_ms",
@@ -1305,6 +1393,8 @@ def _cpu_tier_main():
     micro = {}
     for name, fn in (
         ("fleet_shed_rate", lambda: _fleet_slo_micros(micro)),
+        ("fleet_failover_recovery_ms",
+         lambda: _fleet_failover_micros(micro)),
         ("serve_decode_tokens_per_sec", lambda: _serve_micros(micro)),
         ("serve_warm_prefix_ttft_ms",
          lambda: _serve_ragged_micros(micro)),

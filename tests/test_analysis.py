@@ -984,6 +984,80 @@ def test_blocking_in_router_loop_inline_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# UL113 unguarded-replica-step
+# ---------------------------------------------------------------------
+
+def test_unguarded_replica_step_fires(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        def fleet_loop(engines):
+            while True:
+                for rid in sorted(engines):
+                    engines[rid].serve_step()      # subscripted replica
+        def fan_out(replicas):
+            for eng in replicas:                   # replica-ish iterable
+                eng.serve_step()
+        def two_receivers(a, b, work):
+            while work:
+                a.serve_step()                     # two distinct replicas
+                b.serve_step()
+                work.pop()
+    """)
+    assert sum(1 for f in found if f.rule == "UL113") == 4
+
+
+def test_unguarded_replica_step_silent_cases(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        def guarded_fleet_loop(engines, health, evict):
+            # the sanctioned shape: typed fault handling around the step
+            while True:
+                for rid in sorted(engines):
+                    try:
+                        engines[rid].serve_step()
+                    except Exception as exc:
+                        health.record_exception(rid, exc)
+                        evict(rid)
+        def health_recorded(replicas, health):
+            # health recording in the loop also sanctions a bare step
+            for rid, eng in replicas.items():
+                eng.serve_step()
+                health.observe(rid, eng.load_snapshot(), eng.has_work())
+        def self_driver(self):
+            # an engine driving ITSELF is its own run loop, not a fleet
+            while self.serve_step():
+                pass
+        def solo_harness(eng, n):
+            # a bench/test harness driving ONE local engine: no fan-out
+            for _ in range(n):
+                eng.serve_step()
+        def no_loop(eng2):
+            eng2.serve_step()                      # not in a loop at all
+    """)
+    assert "UL113" not in rules_of(found)
+
+
+def test_unguarded_replica_step_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "router.py", """
+        def fleet_loop(engines):
+            for rid in sorted(engines):
+                engines[rid].serve_step()  # unicore-lint: disable=UL113
+    """)
+    assert "UL113" not in rules_of(found)
+
+
+def test_unguarded_replica_step_fleet_package_clean():
+    # the shipped fleet tier must BE the sanctioned shape: every
+    # replica step routed through the guarded/health-recording helper
+    import os
+
+    import unicore_tpu.fleet as fleet_pkg
+
+    root = os.path.dirname(fleet_pkg.__file__)
+    found = lint_paths([root])
+    assert "UL113" not in rules_of(found), [
+        (f.location, f.message) for f in found if f.rule == "UL113"]
+
+
+# ---------------------------------------------------------------------
 # UL110 unguarded-dataset-io
 # ---------------------------------------------------------------------
 

@@ -23,6 +23,8 @@ import pytest
 from examples.lm.model import TransformerLMModel
 from unicore_tpu.fleet import (FleetRouter, HashRing, clip_trace,
                                generate_trace, replay_trace)
+from unicore_tpu.fleet.health import (CircuitBreaker, ReplicaHealth,
+                                      PROGRESS_KEYS)
 from unicore_tpu.fleet.ring import stable_hash
 from unicore_tpu.serve.engine import ServeEngine
 
@@ -188,6 +190,10 @@ def test_load_snapshot_is_stable_typed_dict(lm):
         "draining": bool, "step_ms": float,
         "prefix_hits": int, "prefix_tokens_saved": int,
         "prefix_hit_rate": float,
+        # ISSUE 14 health surface: the retired-token watermark the
+        # router's wedge detector differences, and the host-fault
+        # counter its fault-rate threshold windows
+        "last_progress": int, "host_faults": int,
     }
     assert set(snap) == set(want_types), snap
     for k, t in want_types.items():
@@ -195,6 +201,7 @@ def test_load_snapshot_is_stable_typed_dict(lm):
     assert snap["free_pages"] == POOL["num_pages"] - 1
     assert snap["free_slots"] == POOL["max_batch"]
     assert snap["max_waiting"] == 3 and not snap["draining"]
+    assert snap["last_progress"] == 0 and snap["host_faults"] == 0
     eng2 = ServeEngine(model, params, **POOL)
     assert eng2.load_snapshot()["max_waiting"] is None
 
@@ -386,6 +393,401 @@ def test_duplicate_request_id_rejected(lm):
     router.run_until_complete()
 
 
+# -- failover: health model, circuit breaker, re-dispatch (ISSUE 14) -------
+
+
+def _kill(router, rid):
+    """Make ``rid``'s next serve_step raise — the crash the router's
+    guarded step loop must catch and turn into an eviction."""
+    def boom():
+        raise RuntimeError("chaos: replica killed mid-traffic")
+
+    router.engines[rid].serve_step = boom
+
+
+def _wedge(router, rid):
+    """Make ``rid`` claim work forever while retiring nothing — the
+    logic wedge only the progress watermark can see."""
+    router.engines[rid].serve_step = lambda: True
+
+
+def _health_snap(**kw):
+    snap = {"last_progress": 0, "host_faults": 0, "waiting": 1,
+            "running": 1, "free_pages": 10, "prefix_hits": 0}
+    snap.update(kw)
+    assert set(PROGRESS_KEYS) <= set(snap)
+    return snap
+
+
+def test_ring_discard_is_leave_without_drain():
+    replicas = [f"r{i}" for i in range(4)]
+    ring = HashRing(replicas)
+    keys = [f"sess-{k}" for k in range(256)]
+    before = {k: ring.lookup(k) for k in keys}
+    # discard == remove semantics (only the dead replica's keys move)…
+    assert ring.discard("r1") is True
+    after = {k: ring.lookup(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    assert moved == [k for k in keys if before[k] == "r1"]
+    # …but idempotent: a failover racing a rolling restart that already
+    # took the victim off the ring is a no-op, not a KeyError
+    assert ring.discard("r1") is False
+    assert {k: ring.lookup(k) for k in keys} == after
+    ring.add("r1")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_health_wedge_suspect_then_dead():
+    h = ReplicaHealth(suspect_steps=2, dead_steps=4)
+    snap = _health_snap()
+    assert h.observe("r0", snap, True, step=1) == "healthy"
+    assert h.observe("r0", snap, True, step=2) == "healthy"  # stall 1
+    assert h.observe("r0", snap, True, step=3) == "suspect"  # stall 2
+    # progress (any signature key moving) resets the ladder
+    assert h.observe("r0", _health_snap(last_progress=3), True,
+                     step=4) == "healthy"
+    for s in range(5, 8):
+        h.observe("r0", _health_snap(last_progress=3), True, step=s)
+    assert h.state("r0") == "suspect"
+    assert h.observe("r0", _health_snap(last_progress=3), True,
+                     step=8) == "dead"
+    assert "wedged" in h.reason("r0")
+    # dead is terminal until reset
+    assert h.observe("r0", _health_snap(last_progress=9), True,
+                     step=9) == "dead"
+    h.reset("r0")
+    assert h.state("r0") == "healthy"
+
+
+def test_health_idle_replica_never_wedges():
+    h = ReplicaHealth(suspect_steps=1, dead_steps=2)
+    snap = _health_snap(waiting=0, running=0)
+    for s in range(1, 10):
+        assert h.observe("r0", snap, False, step=s) == "healthy"
+
+
+def test_health_fault_rate_threshold():
+    h = ReplicaHealth(fault_budget=2, fault_window=8)
+    assert h.observe("r0", _health_snap(host_faults=0), True,
+                     step=1) == "healthy"
+    # one fault inside the window: not dead yet
+    assert h.observe("r0", _health_snap(host_faults=1), True,
+                     step=2) == "healthy"
+    # a second inside the same window crosses the budget
+    assert h.observe("r0", _health_snap(host_faults=2), True,
+                     step=3) == "dead"
+    assert "host-fault rate" in h.reason("r0")
+    # the same delta spread WIDER than the window stays healthy
+    h2 = ReplicaHealth(fault_budget=2, fault_window=8)
+    faults = 0
+    for s in range(1, 50, 12):  # one fault every 12 steps
+        state = h2.observe("r0", _health_snap(host_faults=faults,
+                                              last_progress=s),
+                           True, step=s)
+        assert state == "healthy", (s, faults)
+        faults += 1
+
+
+def test_health_crash_is_immediately_dead():
+    h = ReplicaHealth()
+    assert h.record_exception("r0", RuntimeError("boom"),
+                              step=7) == "dead"
+    assert "crash" in h.reason("r0") and "boom" in h.reason("r0")
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(cooldown_steps=3, flap_limit=3, flap_window=50)
+    assert br.state == "closed"
+    with pytest.raises(RuntimeError):
+        br.succeed(0)  # only a half-open probe can close it
+    br.trip(10)
+    assert br.state == "open"
+    assert not br.ready(11) and not br.ready(12)  # cooling down
+    assert br.ready(13)
+    br.probe(13)
+    assert br.state == "half_open" and br.attempts == 1
+    br.succeed(14)
+    assert br.state == "closed"
+    assert br.describe() == {"state": "closed", "trips": 1,
+                             "rejoin_attempts": 1}
+
+
+def test_circuit_breaker_flap_stays_open():
+    br = CircuitBreaker(cooldown_steps=1, flap_limit=3, flap_window=100)
+    br.trip(0)
+    br.probe(1)
+    br.fail(2)      # trip #2
+    br.probe(3)
+    br.fail(4)      # trip #3 -> quarantined inside the window
+    assert br.state == "open" and br.attempts == 2
+    for step in range(5, 100):
+        assert not br.ready(step), step  # flap hold: no more probes
+    # the window eventually slides past the flap burst
+    assert br.ready(105)
+
+
+def test_child_shutdown_lost_is_permanent():
+    from unicore_tpu.resilience.preemption import ChildShutdown
+
+    child = ChildShutdown(name="r0")
+    child.mark_lost()
+    assert child.requested and child.lost
+    child.clear()  # a zombie replica cannot re-open its own drain flag
+    assert child.requested
+
+
+def test_reclaim_include_running_salvages_generated(lm):
+    model, params = lm
+    from unicore_tpu.serve.scheduler import Request
+
+    eng = ServeEngine(model, params, **POOL)
+    eng.submit([Request(prompt=[1 + i, 2, 3], max_new_tokens=6, seed=i,
+                        request_id=f"s{i}") for i in range(3)])
+    for _ in range(3):
+        eng.serve_step()
+    assert eng.scheduler.running, "setup: nothing admitted"
+    salvaged = eng.reclaim_waiting(include_running=True)
+    ids = [req.request_id for req, _ in salvaged]
+    assert sorted(ids) == ["s0", "s1", "s2"]
+    # running sequences come first and carry their generated tokens
+    assert salvaged[0][1], "running head salvaged without its tokens"
+    assert not eng.has_work() and eng.pool.is_idle()
+    # a healthy engine ADOPTS the salvage and continues the exact stream
+    eng2 = ServeEngine(model, params, **POOL)
+    for req, generated in salvaged:
+        eng2.adopt(req, generated=generated)
+    while eng2.serve_step():
+        pass
+    done = {r.request_id: r for r in eng2.collect_finished()}
+    for req, _ in salvaged:
+        assert done[req.request_id].tokens == solo_tokens(lm, req)
+
+
+def test_failover_crash_reroutes_token_identical(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2)
+    reqs = [Request(prompt=[1 + i, 2, 3, 4], max_new_tokens=8, seed=i,
+                    request_id=f"q{i}") for i in range(10)]
+    homes = {router.submit(req, session_key=f"s{i}")
+             for i, req in enumerate(reqs)}
+    assert homes == {"r0", "r1"}, "setup: both replicas must hold work"
+    for _ in range(3):
+        router.step()
+        router.collect()
+    _kill(router, "r0")
+    router.run_until_complete()
+    results = router.results()
+    assert len(results) == len(reqs)
+    assert router.stats["replicas_lost"] == 1
+    assert router.stats["failovers"] >= 1
+    assert "r0" not in router.engines and "r0" not in router.ring
+    for req in reqs:
+        res = results[req.request_id]
+        assert res.finish_reason in ("eos", "length", "capacity"), res
+        assert res.tokens == solo_tokens(lm, req), req.request_id
+    survivor = router.engines["r1"]
+    survivor.pool.check_invariants()
+    assert survivor.pool.is_idle()
+    rep = router.fleet_report()
+    assert rep["lost"]["r0"]["reason"].startswith("crash")
+    assert rep["breakers"]["r0"]["state"] == "open"
+    assert rep["health"]["r1"]["state"] == "healthy"
+
+
+def test_failover_salvage_not_stranded_on_already_stepped_replica(lm):
+    """Regression: ALL work lives on the crashing replica while the
+    survivor (which sorts FIRST, so it already stepped this fleet
+    step) is idle.  The eviction step must still report progress, or
+    run_until_complete() exits with the salvage adopted-but-never-
+    decoded."""
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2)
+    hot = [f"k{i}" for i in range(400)
+           if router.ring.lookup(f"k{i}") == "r1"][:4]
+    reqs = [Request(prompt=[1 + i, 2, 3], max_new_tokens=4, seed=i,
+                    request_id=f"z{i}") for i in range(len(hot))]
+    for req, sess in zip(reqs, hot):
+        assert router.submit(req, session_key=sess) == "r1"
+    router.step()
+    _kill(router, "r1")
+    router.run_until_complete()
+    results = router.results()
+    assert not router.has_work(), "salvage stranded on the survivor"
+    assert len(results) == len(reqs)
+    for req in reqs:
+        assert results[req.request_id].finish_reason in ("eos", "length")
+        assert results[req.request_id].tokens == solo_tokens(lm, req)
+
+
+def test_adopt_rejects_prefix_outgrowing_pool(lm):
+    """Heterogeneous-fleet guard: a salvaged prompt+generated that can
+    never fit the adopter's pool is rejected at add() (typed), and the
+    router turns that into a 'replica_lost' terminal instead of
+    pinning waiting[0] forever."""
+    model, params = lm
+    from unicore_tpu.serve.scheduler import Request
+
+    tiny = ServeEngine(model, params, num_pages=4, page_size=4,
+                       max_batch=2)
+    req = Request(prompt=[1, 2, 3, 4, 5, 6], max_new_tokens=12, seed=0,
+                  request_id="big")
+    with pytest.raises(ValueError):
+        # 6 prompt + 8 generated = 14 tokens -> 4 pages > 3 usable
+        tiny.adopt(req, generated=list(range(1, 9)))
+
+
+def test_failover_wedge_detected_and_evicted(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(
+        lm, n=2,
+        router_kw=dict(health=ReplicaHealth(suspect_steps=2,
+                                            dead_steps=4)),
+    )
+    reqs = [Request(prompt=[2 + i, 3, 4], max_new_tokens=6, seed=i,
+                    request_id=f"w{i}") for i in range(8)]
+    for i, req in enumerate(reqs):
+        router.submit(req, session_key=f"s{i}")
+    router.step()
+    _wedge(router, "r0")
+    steps = 0
+    while router.step():
+        router.collect()
+        steps += 1
+        assert steps < 500, "wedged replica never evicted — fleet hung"
+    results = router.collect()
+    assert "r0" not in router.engines
+    assert "wedged" in router.fleet_report()["lost"]["r0"]["reason"]
+    for req in reqs:
+        res = results[req.request_id]
+        assert res.finish_reason in ("eos", "length"), res
+        assert res.tokens == solo_tokens(lm, req), req.request_id
+
+
+def test_failover_budget_terminates_replica_lost(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2, router_kw=dict(max_failovers=0))
+    reqs = [Request(prompt=[1 + i, 2, 3], max_new_tokens=8, seed=i,
+                    request_id=f"m{i}") for i in range(8)]
+    assigned = {req.request_id: router.submit(req, session_key=f"s{i}")
+                for i, req in enumerate(reqs)}
+    for _ in range(2):
+        router.step()
+        router.collect()
+    _kill(router, "r0")
+    router.run_until_complete()
+    results = router.results()
+    assert len(results) == len(reqs)
+    lost = [r for r in results.values()
+            if r.finish_reason == "replica_lost"]
+    done_before_kill = sum(
+        1 for req in reqs
+        if assigned[req.request_id] == "r0"
+        and results[req.request_id].finish_reason in ("eos", "length"))
+    # every r0 request not already finished terminates typed — never
+    # silently stranded, never rerouted past the budget
+    assert len(lost) + done_before_kill == sum(
+        1 for rid in assigned.values() if rid == "r0")
+    assert lost, "setup: r0 held no unfinished work at the kill"
+    assert router.stats["replica_lost"] == len(lost)
+    for req in reqs:
+        res = results[req.request_id]
+        if res.finish_reason == "replica_lost":
+            assert res.ttft_ms is None or res.tokens  # partial tokens kept
+        else:
+            assert res.tokens == solo_tokens(lm, req)
+
+
+def test_breaker_rejoin_after_canary(lm):
+    model, params = lm
+    from unicore_tpu.serve.scheduler import Request
+
+    def factory(rid):
+        del rid
+        return ServeEngine(model, params, **POOL)
+
+    router = make_fleet(
+        lm, n=2,
+        router_kw=dict(
+            factory=factory,
+            breaker=lambda rid: CircuitBreaker(cooldown_steps=3),
+        ),
+    )
+    for i in range(6):
+        router.submit(Request(prompt=[1 + i, 2], max_new_tokens=4,
+                              seed=i, request_id=f"j{i}"),
+                      session_key=f"s{i}")
+    for _ in range(2):
+        router.step()
+    _kill(router, "r0")
+    for _ in range(40):
+        router.step()
+        router.collect()
+    assert "r0" in router.engines, router.fleet_report()
+    assert "r0" in router.ring
+    assert router.stats["rejoins"] == 1
+    rep = router.fleet_report()
+    assert rep["breakers"]["r0"]["state"] == "closed"
+    assert rep["breakers"]["r0"]["rejoin_attempts"] == 1
+    # rejoin restores the ORIGINAL ring mapping (warm sessions return)
+    fresh = HashRing(["r0", "r1"])
+    for k in range(64):
+        assert router.ring.lookup(f"u{k}") == fresh.lookup(f"u{k}")
+    # and the rejoined replica actually serves
+    sess = next(f"v{k}" for k in range(64)
+                if router.ring.lookup(f"v{k}") == "r0")
+    probe = Request(prompt=[5, 6], max_new_tokens=2, seed=9,
+                    request_id="after-rejoin")
+    assert router.submit(probe, session_key=sess) == "r0"
+    router.run_until_complete()
+    assert router.results()["after-rejoin"].finish_reason in (
+        "eos", "length")
+
+
+def test_breaker_flap_holds_replica_out(lm):
+    model, params = lm
+    from unicore_tpu.serve.scheduler import Request
+
+    def flapping_factory(rid):
+        del rid
+        eng = ServeEngine(model, params, **POOL)
+
+        def boom():
+            raise RuntimeError("chaos: replacement dies on arrival")
+
+        eng.serve_step = boom
+        return eng
+
+    router = make_fleet(
+        lm, n=2,
+        router_kw=dict(
+            factory=flapping_factory,
+            breaker=lambda rid: CircuitBreaker(
+                cooldown_steps=2, flap_limit=3, flap_window=512),
+        ),
+    )
+    for i in range(4):
+        router.submit(Request(prompt=[1 + i, 2], max_new_tokens=4,
+                              seed=i, request_id=f"f{i}"),
+                      session_key=f"s{i}")
+    router.step()
+    _kill(router, "r0")
+    for _ in range(80):
+        router.step()
+        router.collect()
+    rep = router.fleet_report()
+    # the flapping slot is HELD OUT: bounded rejoin attempts, breaker
+    # open, replica off the ring — it cannot thrash the mapping
+    assert "r0" not in router.engines and "r0" not in router.ring
+    assert rep["breakers"]["r0"]["state"] == "open"
+    assert rep["breakers"]["r0"]["rejoin_attempts"] <= 3
+    assert rep["breakers"]["r0"]["rejoin_attempts"] >= 1
+    assert not router.has_work()
+
+
 # -- the full chaos leg (slow sibling of the fast test above) --------------
 
 
@@ -408,3 +810,46 @@ def test_chaos_fleet_rolling_leg():
     assert leg["survivors_exact"] and leg["pools_idle"]
     assert not leg["affinity_split_sessions"]
     assert leg["remapped_on_leave"] <= leg["remap_bound"]
+
+
+@pytest.mark.slow
+def test_chaos_fleet_failover_legs():
+    """The three ISSUE-14 legs end to end through the harness CLI —
+    the slow siblings of the fast failover tests above."""
+    import json
+
+    for flag, key, checks in (
+        ("--kill-replica", "fleet_kill",
+         lambda f: (f["survivors_exact"] and not f["missing"]
+                    and not f["typed"] and f["deterministic_replay"]
+                    and f["replicas_lost"] == 1
+                    and f["replica_lost_default"] == 0
+                    and len(f["budget_zero_replica_lost"])
+                    == f["budget_zero_salvaged"]
+                    and f["survivor_pools_idle"])),
+        ("--wedge-replica", "fleet_wedge",
+         lambda f: ("wedged" in f["lost"]["reason"]
+                    and f["detect_lag_steps"]
+                    <= f["dead_steps_budget"] + 2
+                    and not f["expired"] and f["survivors_exact"]
+                    and f["survivor_pools_idle"])),
+        ("--flap", "fleet_flap",
+         lambda f: (f["breaker_state"] == "open" and f["held_out"]
+                    and 1 <= f["rejoin_attempts"] <= f["flap_limit"]
+                    and f["survivors_exact"]
+                    and f["survivor_pools_idle"])),
+    ):
+        out = os.path.join("/tmp", f"chaos_fleet_{key}.json")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "unicore_chaos.py"),
+             "--serve", "--fleet", flag, "--json", out],
+            cwd=REPO, capture_output=True, text=True, timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 0, (
+            flag, proc.stdout[-3000:] + proc.stderr[-3000:])
+        with open(out) as f:
+            leg = json.load(f)[key]
+        assert checks(leg), (flag, leg)

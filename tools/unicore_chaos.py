@@ -56,15 +56,33 @@ applied to the continuous-batching engine):
                              bit-identical to the solo oracle (no
                              starvation under chaos preemption).
 
-Fleet-tier leg (``--serve --fleet --rolling``, ISSUE 11): a 2-replica
+Fleet-tier legs (``--serve --fleet``, ISSUEs 11 + 14): a 2-replica
 in-process fleet (consistent-hash session affinity + SLO routing,
-unicore_tpu/fleet/) serves a seeded bursty replay trace while EVERY
-replica is upgraded one at a time — each drain is SIGTERM-driven
-through its ChildShutdown (the identical flag path a delivered signal
-flips).  Asserts: exit 0, ZERO admitted requests dropped (no
-failed/expired/shed finishes), every request's tokens bit-identical to
-a solo-engine oracle, session affinity held outside the restart window,
-remap bounded on membership change, and every replica pool idle.
+unicore_tpu/fleet/) serves a seeded bursty replay trace through a
+membership fault:
+
+  --rolling        PLANNED change: every replica upgraded one at a
+                   time, each drain SIGTERM-driven through its
+                   ChildShutdown (the identical flag path a delivered
+                   signal flips).  Asserts: exit 0, ZERO admitted
+                   requests dropped, tokens bit-identical to a
+                   solo-engine oracle, affinity held outside the
+                   restart window, bounded remap, idle pools;
+  --kill-replica   UNPLANNED crash: one replica's serve_step raises
+                   mid-replay; the router evicts it (leave-without-
+                   drain), fails its sessions over with generated
+                   tokens carried, survivors stay solo-oracle-exact,
+                   the replay is deterministic run to run, and a
+                   budget-zero phase proves salvage terminates
+                   'replica_lost' ONLY at max_failovers;
+  --wedge-replica  logic wedge: the replica claims work but retires
+                   nothing — only the last_progress watermark can see
+                   it; eviction must land within the configured
+                   progress budget with zero blown admitted deadlines;
+  --flap           flapping replacements: every factory replacement
+                   dies on arrival; the circuit breaker bounds rejoin
+                   attempts at flap_limit and holds the slot
+                   quarantined off the ring.
 
 Input-pipeline legs (``--data``, ISSUE 9 — the fault ladder extended
 into the data layer, docs/fault_tolerance.md "Input pipeline"):
@@ -87,9 +105,9 @@ into the data layer, docs/fault_tolerance.md "Input pipeline"):
 CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
 (SIGKILL at a random step + one torn shard + bit-exact resume), the
 ``--inject nonfinite:4`` leg, the serve poison + graceful + flood legs,
-the fleet ``--serve --fleet --rolling`` leg, and the ``--data
-corrupt:2`` + ``--data hang`` legs.
-Exit code 0 iff every assertion holds.
+the four fleet legs (``--rolling``, ``--kill-replica``,
+``--wedge-replica``, ``--flap``), and the ``--data corrupt:2`` +
+``--data hang`` legs.  Exit code 0 iff every assertion holds.
 """
 
 import argparse
@@ -730,6 +748,337 @@ def serve_fleet_rolling_leg(args, report):
         )
 
 
+def _fleet_setup(args, *, num_requests=28):
+    """Shared fleet-leg plumbing: demo model, a clipped seeded trace,
+    and an engine factory at the serve chaos pool shape."""
+    from unicore_tpu.fleet.trace import clip_trace, generate_trace
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.engine import ServeEngine
+
+    model, params = _demo_model(args.seed)
+
+    def factory(rid):
+        del rid
+        return ServeEngine(model, params, **SERVE_POOL)
+
+    trace = clip_trace(
+        generate_trace(args.seed, num_requests=num_requests,
+                       vocab=model.vocab_size, body_len_clip=(1, 20)),
+        (SERVE_POOL["num_pages"] - 1) * SERVE_POOL["page_size"],
+    )
+    return model, params, factory, trace
+
+
+def _fleet_outcome(router, model, params, trace):
+    """Per-request verdicts after a fleet chaos replay: every admitted
+    request must either carry tokens bit-identical to its solo oracle
+    or a TYPED terminal reason; anything else is a drop."""
+    results = router.results()
+    missing = [e.request.request_id for e in trace
+               if e.request.request_id not in results]
+    typed, mismatches, exact = [], [], 0
+    for ev in trace:
+        rid = ev.request.request_id
+        if rid in missing:
+            continue
+        res = results[rid]
+        if res.finish_reason in ("eos", "length", "capacity"):
+            want = _solo_tokens(model, params, ev.request)
+            if res.tokens == want:
+                exact += 1
+            else:
+                mismatches.append({"request": rid, "got": res.tokens,
+                                   "want": want})
+        else:
+            typed.append((rid, res.finish_reason))
+    return {
+        "missing": missing, "typed": sorted(typed),
+        "mismatches": mismatches, "bit_exact_survivors": exact,
+        "tokens": {e.request.request_id:
+                   results[e.request.request_id].tokens
+                   for e in trace if e.request.request_id in results},
+        "reasons": {e.request.request_id:
+                    results[e.request.request_id].finish_reason
+                    for e in trace if e.request.request_id in results},
+    }
+
+
+def serve_fleet_kill_leg(args, report):
+    """``--serve --fleet --kill-replica``: one of two replicas CRASHES
+    mid-replay (its serve_step raises — the shape the engine only
+    takes when its donated pool buffers are gone).  The router must
+    catch the typed fault, evict the replica off the ring, and
+    re-dispatch its salvaged requests (generated tokens carried) to
+    the survivor.  Run TWICE: the whole outcome — tokens, reasons,
+    eviction step, failover counters — must replay bit-identically.
+    A third run at ``max_failovers=0`` proves the typed terminal:
+    every salvaged request (and ONLY those) finishes
+    ``replica_lost``."""
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import replay_trace
+
+    kill_step = 4
+    model, params, factory, trace = _fleet_setup(args)
+    print(f"[chaos] fleet kill leg: {len(trace)} arrivals into 2 "
+          f"replicas; r0 crashes at fleet step {kill_step} (twice, "
+          f"asserting determinism)", flush=True)
+
+    def run(max_failovers=2):
+        router = FleetRouter({rid: factory(rid) for rid in ("r0", "r1")},
+                             max_failovers=max_failovers)
+
+        def hook(step, r):
+            if step == kill_step and "r0" in r.engines:
+                def boom():
+                    raise RuntimeError("chaos: replica r0 killed")
+
+                r.engines["r0"].serve_step = boom
+
+        replay_trace(router, trace, on_step=hook)
+        return router, _fleet_outcome(router, model, params, trace)
+
+    r1, o1 = run()
+    r2, o2 = run()
+    survivors_idle = all(e.pool.is_idle() for e in r1.engines.values())
+    for eng in r1.engines.values():
+        eng.pool.check_invariants()
+    rep1 = r1.fleet_report()
+    deterministic = (
+        o1["tokens"] == o2["tokens"] and o1["reasons"] == o2["reasons"]
+        and r1.stats == r2.stats
+        and rep1["lost"] == r2.fleet_report()["lost"]
+    )
+
+    # typed-terminal phase: max_failovers=0 turns every salvaged
+    # request into a replica_lost, and nothing else
+    r0b, o0 = run(max_failovers=0)
+    lost_ids = sorted(rid for rid, reason in o0["typed"]
+                      if reason == "replica_lost")
+    salvaged = r0b.fleet_report()["lost"]["r0"]["salvaged"]
+
+    report["fleet_kill"] = {
+        "arrivals": len(trace), "kill_step": kill_step,
+        "replicas_lost": r1.stats["replicas_lost"],
+        "failovers": r1.stats["failovers"],
+        "replica_lost_default": r1.stats["replica_lost"],
+        "missing": o1["missing"], "typed": o1["typed"],
+        "survivors_exact": not o1["mismatches"],
+        "bit_exact_survivors": o1["bit_exact_survivors"],
+        "mismatches": o1["mismatches"][:5],
+        "survivor_pools_idle": survivors_idle,
+        "deterministic_replay": deterministic,
+        "breaker": rep1["breakers"].get("r0"),
+        "lost": rep1["lost"],
+        "budget_zero_replica_lost": lost_ids,
+        "budget_zero_salvaged": salvaged,
+    }
+    if o1["missing"]:
+        raise RuntimeError(
+            f"fleet kill leg: requests vanished (neither tokens nor a "
+            f"typed reason): {o1['missing']}"
+        )
+    if r1.stats["replicas_lost"] != 1 or r1.stats["failovers"] < 1:
+        raise RuntimeError(
+            f"fleet kill leg: the kill was not exercised — "
+            f"replicas_lost={r1.stats['replicas_lost']} "
+            f"failovers={r1.stats['failovers']}"
+        )
+    if o1["mismatches"]:
+        raise RuntimeError(
+            f"fleet kill leg: {len(o1['mismatches'])} failed-over "
+            f"stream(s) diverged from the solo oracle: "
+            f"{o1['mismatches'][:3]}"
+        )
+    if o1["typed"] or r1.stats["replica_lost"]:
+        # one death against max_failovers=2: nothing may terminate
+        # replica_lost — the typed reason fires ONLY at the budget
+        raise RuntimeError(
+            f"fleet kill leg: typed terminations below the failover "
+            f"budget: {o1['typed']}"
+        )
+    if not survivors_idle:
+        raise RuntimeError("fleet kill leg: survivor pool pages leaked")
+    if not deterministic:
+        raise RuntimeError(
+            "fleet kill leg: the replay was NOT deterministic — "
+            f"stats {r1.stats} vs {r2.stats}"
+        )
+    if not lost_ids or len(lost_ids) != salvaged:
+        raise RuntimeError(
+            f"fleet kill leg: at max_failovers=0 every salvaged "
+            f"request must finish replica_lost — salvaged={salvaged} "
+            f"replica_lost={lost_ids}"
+        )
+    if o0["mismatches"]:
+        raise RuntimeError(
+            f"fleet kill leg: budget-zero survivors diverged: "
+            f"{o0['mismatches'][:3]}"
+        )
+
+
+def serve_fleet_wedge_leg(args, report):
+    """``--serve --fleet --wedge-replica``: one replica WEDGES (claims
+    work forever, retires nothing — no exception to catch).  Only the
+    progress watermark can see it: the router must mark it suspect,
+    then dead within the configured progress budget, evict it, and
+    finish the trace on the survivor without blowing any admitted
+    deadline."""
+    from unicore_tpu.fleet.health import ReplicaHealth
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import replay_trace
+
+    wedge_step = 4
+    suspect_steps, dead_steps = 3, 6
+    model, params, factory, trace = _fleet_setup(args)
+    # generous wall deadline: the leg proves the WEDGE never stalls the
+    # fleet into expiry, not that CPU steps are fast
+    for ev in trace:
+        ev.request.deadline_ms = 120000.0
+    print(f"[chaos] fleet wedge leg: r0 wedges at fleet step "
+          f"{wedge_step}; progress budget {dead_steps} steps",
+          flush=True)
+    wedged_at = []
+
+    def hook(step, r):
+        if step == wedge_step and "r0" in r.engines and not wedged_at:
+            r.engines["r0"].serve_step = lambda: True
+            wedged_at.append(step)
+
+    router = FleetRouter(
+        {rid: factory(rid) for rid in ("r0", "r1")},
+        health=ReplicaHealth(suspect_steps=suspect_steps,
+                             dead_steps=dead_steps),
+    )
+    replay_trace(router, trace, on_step=hook)
+    outcome = _fleet_outcome(router, model, params, trace)
+    rep = router.fleet_report()
+    lost = rep["lost"].get("r0")
+    detect_lag = (None if not (lost and wedged_at)
+                  else lost["fleet_step"] - wedged_at[0])
+    expired = [rid for rid, reason in outcome["typed"]
+               if reason == "expired"]
+    report["fleet_wedge"] = {
+        "arrivals": len(trace), "wedge_step": wedged_at,
+        "dead_steps_budget": dead_steps, "lost": lost,
+        "detect_lag_steps": detect_lag,
+        "missing": outcome["missing"], "typed": outcome["typed"],
+        "expired": expired,
+        "survivors_exact": not outcome["mismatches"],
+        "mismatches": outcome["mismatches"][:5],
+        "survivor_pools_idle": all(
+            e.pool.is_idle() for e in router.engines.values()),
+    }
+    if not wedged_at:
+        raise RuntimeError("fleet wedge leg: the wedge hook never "
+                           "fired — the trace finished in < 5 steps")
+    if lost is None or "wedged" not in lost["reason"]:
+        raise RuntimeError(
+            f"fleet wedge leg: r0 was never evicted as wedged: {lost}"
+        )
+    # the stall is observed one step after the wedge lands, so the
+    # eviction must come within dead_steps + 2 fleet steps
+    if detect_lag > dead_steps + 2:
+        raise RuntimeError(
+            f"fleet wedge leg: eviction took {detect_lag} fleet steps "
+            f"against a budget of {dead_steps}"
+        )
+    if outcome["missing"] or expired or outcome["typed"]:
+        raise RuntimeError(
+            f"fleet wedge leg: dropped/expired admitted requests — "
+            f"missing={outcome['missing']} typed={outcome['typed']}"
+        )
+    if outcome["mismatches"]:
+        raise RuntimeError(
+            f"fleet wedge leg: {len(outcome['mismatches'])} stream(s) "
+            f"diverged from the solo oracle"
+        )
+    if not report["fleet_wedge"]["survivor_pools_idle"]:
+        raise RuntimeError("fleet wedge leg: survivor pool pages leaked")
+
+
+def serve_fleet_flap_leg(args, report):
+    """``--serve --fleet --flap``: the dead replica's replacements keep
+    dying on arrival.  The circuit breaker must let each half-open
+    canary fail, then hold the slot QUARANTINED after ``flap_limit``
+    trips — bounded rejoin attempts, ring mapping never thrashed, and
+    every request still finishes on the survivor, solo-exact."""
+    from unicore_tpu.fleet.health import CircuitBreaker
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import replay_trace
+
+    kill_step = 3
+    flap_limit = 3
+    model, params, factory, trace = _fleet_setup(args)
+
+    def flapping_factory(rid):
+        eng = factory(rid)
+
+        def boom():
+            raise RuntimeError("chaos: replacement dies on arrival")
+
+        eng.serve_step = boom
+        return eng
+
+    print(f"[chaos] fleet flap leg: r0 killed at step {kill_step}; "
+          f"every replacement dies; breaker flap_limit={flap_limit}",
+          flush=True)
+    router = FleetRouter(
+        {rid: factory(rid) for rid in ("r0", "r1")},
+        factory=flapping_factory,
+        breaker=lambda rid: CircuitBreaker(
+            cooldown_steps=2, flap_limit=flap_limit, flap_window=4096),
+    )
+
+    def hook(step, r):
+        if step == kill_step and "r0" in r.engines:
+            def boom():
+                raise RuntimeError("chaos: replica r0 killed")
+
+            r.engines["r0"].serve_step = boom
+
+    replay_trace(router, trace, on_step=hook)
+    # the trace may outlast the flap burst; give the breaker room to
+    # prove it STAYS open (no further probes) on an idle fleet
+    for _ in range(60):
+        router.step()
+    router.collect()
+    outcome = _fleet_outcome(router, model, params, trace)
+    rep = router.fleet_report()
+    breaker = rep["breakers"].get("r0") or {}
+    report["fleet_flap"] = {
+        "arrivals": len(trace), "kill_step": kill_step,
+        "flap_limit": flap_limit,
+        "rejoin_attempts": breaker.get("rejoin_attempts"),
+        "breaker_state": breaker.get("state"),
+        "held_out": "r0" not in router.engines,
+        "missing": outcome["missing"], "typed": outcome["typed"],
+        "survivors_exact": not outcome["mismatches"],
+        "mismatches": outcome["mismatches"][:5],
+        "survivor_pools_idle": all(
+            e.pool.is_idle() for e in router.engines.values()),
+    }
+    if not breaker or breaker.get("state") != "open":
+        raise RuntimeError(
+            f"fleet flap leg: breaker not held open: {breaker}"
+        )
+    if not 1 <= (breaker.get("rejoin_attempts") or 0) <= flap_limit:
+        raise RuntimeError(
+            f"fleet flap leg: rejoin attempts not bounded by the flap "
+            f"limit: {breaker}"
+        )
+    if "r0" in router.engines or "r0" in router.ring:
+        raise RuntimeError("fleet flap leg: the flapping replica got "
+                           "back onto the ring")
+    if outcome["missing"] or outcome["typed"] or outcome["mismatches"]:
+        raise RuntimeError(
+            f"fleet flap leg: missing={outcome['missing']} "
+            f"typed={outcome['typed']} "
+            f"mismatches={outcome['mismatches'][:3]}"
+        )
+    if not report["fleet_flap"]["survivor_pools_idle"]:
+        raise RuntimeError("fleet flap leg: survivor pool pages leaked")
+
+
 def serve_main(args):
     import tempfile
 
@@ -754,15 +1103,34 @@ def serve_main(args):
         serve_graceful_leg(args, report, workdir)
         legs.append("graceful")
     if args.fleet:
-        if not args.rolling:
-            raise SystemExit("--serve --fleet needs --rolling (the "
-                             "rolling-restart leg is the fleet leg)")
-        serve_fleet_rolling_leg(args, report)
-        legs.append("fleet-rolling")
+        wanted = [name for name, on in (
+            ("rolling", args.rolling),
+            ("kill-replica", args.kill_replica),
+            ("wedge-replica", args.wedge_replica),
+            ("flap", args.flap),
+        ) if on]
+        if not wanted:
+            raise SystemExit(
+                "--serve --fleet needs at least one of --rolling, "
+                "--kill-replica, --wedge-replica, --flap"
+            )
+        if args.rolling:
+            serve_fleet_rolling_leg(args, report)
+            legs.append("fleet-rolling")
+        if args.kill_replica:
+            serve_fleet_kill_leg(args, report)
+            legs.append("fleet-kill")
+        if args.wedge_replica:
+            serve_fleet_wedge_leg(args, report)
+            legs.append("fleet-wedge")
+        if args.flap:
+            serve_fleet_flap_leg(args, report)
+            legs.append("fleet-flap")
     if not legs:
         raise SystemExit(
             "--serve needs at least one of --inject poison:K, --flood, "
-            "--graceful, --fleet --rolling"
+            "--graceful, or --fleet with --rolling/--kill-replica/"
+            "--wedge-replica/--flap"
         )
     report["legs"] = legs
     if args.json:
@@ -1128,13 +1496,35 @@ def build_parser():
                         "flood: bounded queue, deterministic sheds, no "
                         "starvation")
     p.add_argument("--fleet", action="store_true",
-                   help="(with --serve --rolling) fleet-tier chaos: a "
-                        "2-replica router under seeded bursty load")
+                   help="(with --serve) fleet-tier chaos: a 2-replica "
+                        "router under seeded bursty load; combine with "
+                        "--rolling / --kill-replica / --wedge-replica "
+                        "/ --flap")
     p.add_argument("--rolling", action="store_true",
                    help="(with --serve --fleet) rolling restart: "
                         "SIGTERM-driven one-replica-at-a-time upgrade "
                         "drops zero admitted requests, survivors "
                         "token-identical to the solo oracle, pools idle")
+    p.add_argument("--kill-replica", action="store_true",
+                   help="(with --serve --fleet) UNPLANNED crash: one "
+                        "replica's serve_step raises mid-replay; the "
+                        "router must evict it, fail its sessions over "
+                        "(generated tokens carried), keep survivors "
+                        "solo-oracle-exact, replay deterministically "
+                        "twice, and terminate salvage 'replica_lost' "
+                        "ONLY at max_failovers")
+    p.add_argument("--wedge-replica", action="store_true",
+                   help="(with --serve --fleet) logic wedge: one "
+                        "replica claims work but retires nothing; the "
+                        "progress watermark must evict it within the "
+                        "configured budget and the fleet must finish "
+                        "without blowing admitted deadlines")
+    p.add_argument("--flap", action="store_true",
+                   help="(with --serve --fleet) flapping replacements: "
+                        "every factory replacement dies on arrival; "
+                        "the circuit breaker must bound rejoin "
+                        "attempts at flap_limit and hold the slot "
+                        "quarantined off the ring")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")

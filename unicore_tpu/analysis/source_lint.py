@@ -77,6 +77,26 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   iteration's already-on-host value (the manual lag-1 idiom) and is
   silent too.
 
+- UL113 unguarded-replica-step: a bare ``<replica>.serve_step()`` call
+  inside a FLEET/ROUTER fan-out loop with neither typed fault handling
+  (an enclosing ``try`` with a handler inside the loop) nor health
+  recording (a ``record_*``/``observe*`` call, or anything reached
+  through a ``health`` receiver) anywhere in the loop.  A fan-out loop
+  is one that steps replicas it does not own: the stepped receiver is
+  subscripted out of a collection (``engines[rid].serve_step()``), the
+  loop iterates something named like a replica set
+  (replica/engine/fleet), or two distinct replica receivers are
+  stepped.  An engine driving ITSELF (``self.serve_step()``) or a
+  harness driving one local engine is not a fleet loop and never
+  fires.  The hazard: the engine only lets an exception escape
+  ``serve_step`` when it cannot continue — unguarded, that one
+  replica's crash re-raises out of the fan-out loop and takes every
+  OTHER replica's traffic with it, and a wedged replica (claiming work,
+  retiring nothing) is never noticed at all.  Route replica steps
+  through a guarded helper that records typed faults and progress into
+  the health model so a dead replica is evicted and its sessions fail
+  over (``fleet/router.py`` ``FleetRouter._step_replica``).
+
 - UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
   ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
   ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
@@ -187,6 +207,12 @@ _ROUTER_LOOP_MARKERS = {"serve_step", "route", "dispatch",
 # argument instead)
 _UL112_METHOD_TAILS = {"item", "block_until_ready"}
 
+# UL113: iterable-name fragments that mark a loop as replica fan-out
+_UL113_FLEET_NAME_FRAGS = ("replica", "engine", "fleet")
+# UL113: call-tail prefixes that count as health recording (plus any
+# chain passing through a "health" receiver)
+_UL113_HEALTH_PREFIXES = ("record_", "observe")
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -216,6 +242,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._step_loop_depth = 0
         self._serve_loop_depth = 0
         self._router_loop_depth = 0
+        self._ul113_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
 
@@ -758,6 +785,121 @@ class _ModuleLint(ast.NodeVisitor):
                 f"instead",
             )
 
+    @staticmethod
+    def _ul113_replica_step(call):
+        """``X.serve_step()`` where X is not bare ``self`` — a REPLICA
+        step (an engine stepping itself is its own driver, not a
+        fan-out).  Returns a display chain or None."""
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "serve_step"):
+            return None
+        recv = call.func.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            return None
+        return _attr_chain(call.func) or "<replica>.serve_step"
+
+    def _loop_has_replica_step(self, loop):
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if (isinstance(sub, ast.Call)
+                    and self._ul113_replica_step(sub) is not None):
+                return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _check_unguarded_replica_step(self, loop):
+        """UL113 over one outermost replica-stepping loop: classify the
+        loop as FLEET FAN-OUT (subscripted receiver, replica-ish
+        iterable name, or >= 2 distinct stepped receivers), check for
+        health recording anywhere in its subtree, then flag every
+        replica step not shielded by a try-with-handler.  Closures
+        defined in the loop are fresh scopes, as everywhere here."""
+        steps = []
+        fleet_shape = False
+        has_health = False
+        stack = [loop]
+        while stack:
+            sub = stack.pop()
+            if sub is not loop and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+                continue
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(sub.iter):
+                    name = None
+                    if isinstance(n, ast.Attribute):
+                        name = n.attr
+                    elif isinstance(n, ast.Name):
+                        name = n.id
+                    if name and any(f in name.lower()
+                                    for f in _UL113_FLEET_NAME_FRAGS):
+                        fleet_shape = True
+            if isinstance(sub, ast.Call):
+                rs = self._ul113_replica_step(sub)
+                if rs is not None:
+                    steps.append((sub, rs))
+                    if any(isinstance(n, ast.Subscript)
+                           for n in ast.walk(sub.func.value)):
+                        fleet_shape = True  # engines[rid].serve_step()
+                chain = _attr_chain(sub.func)
+                tail = chain.split(".")[-1] if chain else (
+                    sub.func.attr if isinstance(sub.func, ast.Attribute)
+                    else None)
+                if tail and tail.startswith(_UL113_HEALTH_PREFIXES):
+                    has_health = True
+                if chain and any("health" in part.lower()
+                                 for part in chain.split(".")[:-1]):
+                    has_health = True
+            stack.extend(ast.iter_child_nodes(sub))
+        if len({chain for _, chain in steps}) >= 2:
+            fleet_shape = True
+        if not steps or not fleet_shape or has_health:
+            return
+
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Try):
+                    covers = guarded or bool(child.handlers)
+                    for stmt in child.body:
+                        walk(stmt, covers)
+                    for h in child.handlers:
+                        for stmt in h.body:
+                            walk(stmt, guarded)
+                    for stmt in child.orelse + child.finalbody:
+                        walk(stmt, guarded)
+                    continue
+                if isinstance(child, ast.Call) and not guarded:
+                    rs = self._ul113_replica_step(child)
+                    if rs is not None:
+                        self.emit(
+                            "UL113", "unguarded-replica-step", "error",
+                            child,
+                            f"bare '{rs}' on a replica inside a "
+                            f"fleet/router loop with no typed fault "
+                            f"handling or health recording — the engine "
+                            f"only lets an exception escape serve_step() "
+                            f"when it cannot continue, so one replica's "
+                            f"crash re-raises out of the fan-out loop "
+                            f"and takes every OTHER replica's traffic "
+                            f"with it, and a wedged replica is never "
+                            f"noticed; step replicas through a guarded "
+                            f"helper that records typed faults and "
+                            f"progress into the health model "
+                            f"(FleetRouter._step_replica) so a dead "
+                            f"replica is evicted and its sessions fail "
+                            f"over",
+                        )
+                walk(child, guarded)
+
+        walk(loop, False)
+
     def _check_blocking_in_router_loop(self, node):
         """UL111: a blocking host call inside a router dispatch loop
         serializes the whole fleet behind one replica."""
@@ -807,6 +949,15 @@ class _ModuleLint(ast.NodeVisitor):
             is_serve = True
         else:
             is_serve = False
+        if self._ul113_depth == 0 and self._loop_has_replica_step(node):
+            # scan once from the OUTERMOST replica-stepping loop: its
+            # subtree carries the fan-out classification (iterables,
+            # receivers) and the guards/health calls alike
+            self._check_unguarded_replica_step(node)
+            self._ul113_depth += 1
+            is_replica_loop = True
+        else:
+            is_replica_loop = False
         if is_step:
             if self._step_loop_depth == 0:
                 # scan once from the OUTERMOST step loop (UL109 pattern):
@@ -823,6 +974,8 @@ class _ModuleLint(ast.NodeVisitor):
             self._router_loop_depth -= 1
         if is_serve:
             self._serve_loop_depth -= 1
+        if is_replica_loop:
+            self._ul113_depth -= 1
 
     def visit_For(self, node):
         self._visit_loop(node)
@@ -837,10 +990,12 @@ class _ModuleLint(ast.NodeVisitor):
         saved, self._step_loop_depth = self._step_loop_depth, 0
         saved_serve, self._serve_loop_depth = self._serve_loop_depth, 0
         saved_router, self._router_loop_depth = self._router_loop_depth, 0
+        saved_ul113, self._ul113_depth = self._ul113_depth, 0
         self.generic_visit(node)
         self._step_loop_depth = saved
         self._serve_loop_depth = saved_serve
         self._router_loop_depth = saved_router
+        self._ul113_depth = saved_ul113
 
     def visit_FunctionDef(self, node):
         self._visit_scope_reset(node)

@@ -10,6 +10,8 @@ _EXPORTS = {
     "HashRing": ("unicore_tpu.fleet.ring", "HashRing"),
     "stable_hash": ("unicore_tpu.fleet.ring", "stable_hash"),
     "FleetRouter": ("unicore_tpu.fleet.router", "FleetRouter"),
+    "ReplicaHealth": ("unicore_tpu.fleet.health", "ReplicaHealth"),
+    "CircuitBreaker": ("unicore_tpu.fleet.health", "CircuitBreaker"),
     "TraceEvent": ("unicore_tpu.fleet.trace", "TraceEvent"),
     "generate_trace": ("unicore_tpu.fleet.trace", "generate_trace"),
     "replay_trace": ("unicore_tpu.fleet.trace", "replay_trace"),

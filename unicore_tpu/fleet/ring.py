@@ -74,6 +74,18 @@ class HashRing:
         self._members.discard(rid)
         self._points = [(p, r) for (p, r) in self._points if r != rid]
 
+    def discard(self, rid):
+        """Idempotent :meth:`remove` — the failover path (a replica can
+        die mid-rolling-restart, AFTER the restart already took it off
+        the ring; eviction must not raise over a no-op).  Returns True
+        when the replica was a member.  This is the leave-WITHOUT-drain
+        entry: the remap properties are identical to a planned
+        ``remove`` — only the dead replica's keys move."""
+        if rid not in self._members:
+            return False
+        self.remove(rid)
+        return True
+
     def lookup(self, key):
         """The replica owning ``key`` (first point clockwise)."""
         if not self._points:

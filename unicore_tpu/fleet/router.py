@@ -30,11 +30,37 @@ a fleet:
   Absolute-step-keyed sampling makes every rerouted request's tokens
   identical to an uninterrupted run — the chaos harness's
   ``--serve --fleet --rolling`` leg asserts it against a solo oracle.
+- **Failover** (ISSUE 14 — the unplanned half of the same story).
+  Every replica step is GUARDED: :meth:`_step_replica` catches typed
+  step exceptions and feeds a per-replica
+  :class:`~unicore_tpu.fleet.health.ReplicaHealth` state machine
+  (``healthy -> suspect -> dead``) that also watches the
+  ``last_progress`` retired-token watermark and the host-fault rate
+  from ``load_snapshot()``.  A DEAD replica is evicted without a
+  drain: it leaves the ring (:meth:`~unicore_tpu.fleet.ring.HashRing.
+  discard`), its ChildShutdown is marked LOST (a zombie that wakes up
+  sheds instead of serving), and every salvaged request — waiting AND
+  running, with its generated-so-far tokens — is re-dispatched to a
+  healthy replica via :meth:`~unicore_tpu.serve.engine.ServeEngine.
+  adopt`: the target re-prefills prompt+generated (page-table lookups
+  under a warm prefix cache) and absolute-step sampling continues the
+  stream token-identically.  A request that outlives ``max_failovers``
+  replica deaths terminates with the typed reason ``replica_lost``
+  instead of looping.  Rejoin goes through a
+  :class:`~unicore_tpu.fleet.health.CircuitBreaker`: after a cooldown
+  the router boots ``factory(rid)`` OFF-RING, feeds it one canary
+  request, and only a completed canary restores the ring mapping —
+  ``flap_limit`` failures inside ``flap_window`` hold a flapping
+  replica quarantined so it cannot thrash the ring.  All of it is
+  deterministic under the seeded trace + injectable clock (the chaos
+  ``--kill-replica`` / ``--wedge-replica`` / ``--flap`` legs replay
+  bit-identically).
 
 The router is single-threaded and cooperative: :meth:`step` advances
 every replica by one ``serve_step`` (never the batch-blocking
-``generate()`` — lint rule UL111 polices that shape), so the whole
-fleet is deterministic under the seeded trace replay
+``generate()`` — lint rule UL111 polices that shape, and UL113 polices
+that replica stepping stays guarded), so the whole fleet is
+deterministic under the seeded trace replay
 (:mod:`~unicore_tpu.fleet.trace`).
 """
 
@@ -43,6 +69,7 @@ import signal as _signal
 
 from unicore_tpu.resilience.preemption import ChildShutdown
 
+from .health import DEAD, CircuitBreaker, ReplicaHealth
 from .ring import HashRing
 
 logger = logging.getLogger(__name__)
@@ -57,6 +84,9 @@ SUM_STATS = (
 )
 MAX_STATS = ("peak_waiting", "peak_pool_occupancy")
 
+DEFAULT_MAX_FAILOVERS = 2
+DEFAULT_PROBE_BUDGET_STEPS = 32
+
 
 class FleetRouter:
     """Route requests over ``engines`` ({replica_id: ServeEngine}).
@@ -67,10 +97,24 @@ class FleetRouter:
     targets one child at a time.  ``deadline_safety`` scales the
     projected-wait estimate before comparing against a deadline (>1 =
     overflow earlier).  ``service_floor_ms`` seeds the wait projection
-    before the first decode has been measured."""
+    before the first decode has been measured.
+
+    Failover knobs (ISSUE 14): ``factory(rid) -> ServeEngine`` builds
+    the replacement a dead replica's circuit breaker probes (None =
+    dead replicas stay lost); ``max_failovers`` bounds how many
+    replica deaths one request may survive before it terminates
+    ``replica_lost``; ``health`` is a pre-built
+    :class:`~unicore_tpu.fleet.health.ReplicaHealth` (None = defaults
+    on ``clock``); ``breaker`` is a ``rid -> CircuitBreaker`` factory;
+    ``probe_budget_steps`` bounds how long a half-open canary may run
+    before the probe counts as failed."""
 
     def __init__(self, engines, *, vnodes=64, shutdown=None,
-                 deadline_safety=1.5, service_floor_ms=1.0):
+                 deadline_safety=1.5, service_floor_ms=1.0,
+                 factory=None, max_failovers=DEFAULT_MAX_FAILOVERS,
+                 health=None, breaker=None,
+                 probe_budget_steps=DEFAULT_PROBE_BUDGET_STEPS,
+                 clock=None):
         if not engines:
             raise ValueError("a fleet needs at least one replica")
         self.engines = dict(engines)
@@ -78,6 +122,11 @@ class FleetRouter:
         self.shutdown = shutdown
         self.deadline_safety = float(deadline_safety)
         self.service_floor_ms = float(service_floor_ms)
+        self.factory = factory
+        self.max_failovers = int(max_failovers)
+        self.health = health or ReplicaHealth(clock=clock)
+        self._breaker_factory = breaker or (lambda rid: CircuitBreaker())
+        self.probe_budget_steps = int(probe_budget_steps)
         self._children = {}
         for rid, eng in self.engines.items():
             child = self._make_child(rid)
@@ -86,10 +135,16 @@ class FleetRouter:
         self._results = {}        # request_id -> ServeResult
         self._replica_of = {}     # request_id -> rid (current)
         self._session_of = {}     # request_id -> session key
+        self._failovers = {}      # request_id -> replica deaths survived
         self.session_replicas = {}  # session -> [rid, ...] in route order
+        self._fleet_step = 0
+        self._breakers = {}       # rid -> CircuitBreaker (tripped slots)
+        self._probation = {}      # rid -> half-open canary probe state
+        self._lost = {}           # rid -> eviction record (most recent)
         self.stats = {
             "routed": 0, "overflow_routed": 0, "rerouted": 0,
-            "restarts": 0,
+            "restarts": 0, "failovers": 0, "replica_lost": 0,
+            "replicas_lost": 0, "rejoins": 0,
         }
         self._auto_id = 0
 
@@ -116,15 +171,23 @@ class FleetRouter:
         self.stats["routed"] += 1
         if reason != "affinity":
             self.stats["overflow_routed"] += 1
-        self._replica_of[rid] = choice
-        self._session_of[rid] = session
+        self._record_assignment(rid, session, choice)
+        return choice
+
+    def _record_assignment(self, request_id, session, choice):
+        self._replica_of[request_id] = choice
+        self._session_of[request_id] = session
         self.session_replicas.setdefault(session, [])
         if (not self.session_replicas[session]
                 or self.session_replicas[session][-1] != choice):
             self.session_replicas[session].append(choice)
-        return choice
 
     def _route(self, request, session):
+        if not self.engines:
+            raise RuntimeError(
+                "no live replicas: the whole fleet has been evicted "
+                "(factory-less failover cannot rebuild it)"
+            )
         snaps = {rid: eng.load_snapshot()
                  for rid, eng in self.engines.items()}
         healthy = [rid for rid in sorted(snaps)
@@ -180,16 +243,55 @@ class FleetRouter:
     # -- stepping -------------------------------------------------------
 
     def has_work(self):
-        return any(e.has_work() for e in self.engines.values())
+        return (any(e.has_work() for e in self.engines.values())
+                or any(p["engine"].has_work()
+                       for p in self._probation.values()))
 
     def step(self):
         """One cooperative fleet step: every replica advances by one
-        ``serve_step`` (deterministic replica order).  Returns True
-        while any replica still has work."""
+        guarded ``serve_step`` (deterministic replica order), half-open
+        canaries step off-ring, and the circuit breakers tick.  Returns
+        True while any replica still has work."""
+        self._fleet_step += 1
         busy = False
         for rid in sorted(self.engines):
-            if self.engines[rid].serve_step():
+            if self._step_replica(rid):
                 busy = True
+        if self._step_probation():
+            busy = True
+        self._tick_breakers()
+        # a probe launched by the tick above has not stepped yet: keep
+        # the drive loop alive until its canary settles
+        return busy or bool(self._probation)
+
+    def _step_replica(self, rid):
+        """One GUARDED serve_step on replica ``rid``: typed fault
+        handling plus health recording — the UL113 contract for every
+        replica-stepping loop.  A step exception is a replica CRASH
+        (the engine only re-raises when its donated pool buffers are
+        gone): the replica is evicted and its work fails over; the
+        exception never reaches the fleet loop.  A healthy step feeds
+        the progress/fault-rate health model, which can likewise
+        declare the replica dead (wedge detection)."""
+        eng = self.engines.get(rid)
+        if eng is None:
+            return False  # evicted earlier this very fleet step
+        try:
+            busy = bool(eng.serve_step())
+        except Exception as exc:  # noqa: BLE001 - replica fault != fleet fault
+            self.health.record_exception(rid, exc, step=self._fleet_step)
+            self._evict_replica(rid)
+            # eviction IS progress: the salvage may have been adopted
+            # onto a replica that already stepped THIS fleet step, so
+            # the drive loop must come around again or it strands them
+            return True
+        state = self.health.observe(
+            rid, eng.load_snapshot(), eng.has_work(),
+            step=self._fleet_step,
+        )
+        if state == DEAD:
+            self._evict_replica(rid)
+            return True
         return busy
 
     def collect(self):
@@ -197,10 +299,14 @@ class FleetRouter:
         router's result map (keyed by request_id)."""
         for rid in sorted(self.engines):
             for res in self.engines[rid].collect_finished():
-                self._results[res.request_id] = res
-                self._replica_of.pop(res.request_id, None)
-                self._session_of.pop(res.request_id, None)
+                self._settle_result(res)
         return self._results
+
+    def _settle_result(self, res):
+        self._results[res.request_id] = res
+        self._replica_of.pop(res.request_id, None)
+        self._session_of.pop(res.request_id, None)
+        self._failovers.pop(res.request_id, None)
 
     def run_until_complete(self):
         """Drive the whole fleet to an empty queue and return the
@@ -226,6 +332,222 @@ class FleetRouter:
         out, self._results = self._results, {}
         return out
 
+    # -- failover (ISSUE 14) --------------------------------------------
+
+    def _evict_replica(self, rid):
+        """Evict a DEAD replica without a drain: leave the ring, mark
+        its ChildShutdown lost (a zombie sheds, never serves), salvage
+        every queued/running request WITH its generated tokens, trip
+        the slot's circuit breaker, and re-dispatch the salvage to
+        healthy replicas.  Deterministic: the salvage order is
+        running-first then waiting (the preemption priority), and
+        every routing decision goes through the same ``_route``."""
+        eng = self.engines.pop(rid)
+        reason = self.health.reason(rid) or "dead"
+        self.ring.discard(rid)
+        child = self._children.pop(rid, None)
+        if child is not None:
+            child.mark_lost()
+        # results the replica finished BEFORE dying are valid — harvest
+        # them ahead of the salvage so they never re-dispatch
+        try:
+            for res in eng.collect_finished():
+                self._settle_result(res)
+        except Exception as e:  # noqa: BLE001 - dying replica, best effort
+            logger.warning("harvest from dead replica %r failed: %s",
+                           rid, e)
+        try:
+            salvaged = eng.reclaim_waiting(include_running=True)
+        except Exception as e:  # noqa: BLE001 - dying replica, best effort
+            salvaged = []
+            logger.error(
+                "salvage from dead replica %r failed (%s) — its "
+                "in-flight requests are lost and will be reported as "
+                "replica_lost only if resubmitted", rid, e,
+            )
+        self.stats["replicas_lost"] += 1
+        self._lost[rid] = {
+            "reason": reason, "fleet_step": self._fleet_step,
+            "salvaged": len(salvaged),
+        }
+        breaker = self._breakers.get(rid)
+        if breaker is None:
+            breaker = self._breakers[rid] = self._breaker_factory(rid)
+        breaker.trip(self._fleet_step)
+        logger.error(
+            "replica %r EVICTED at fleet step %d (%s): %d request(s) "
+            "fail over to %d surviving replica(s)",
+            rid, self._fleet_step, reason, len(salvaged),
+            len(self.engines),
+        )
+        for req, generated in salvaged:
+            self._failover_request(req, generated)
+
+    def _failover_request(self, req, generated):
+        """Re-dispatch one salvaged request: a healthy replica adopts
+        it (re-prefill of prompt+generated; absolute-step sampling
+        keeps the continuation token-identical), unless it has now
+        outlived ``max_failovers`` replicas — then it terminates with
+        the typed reason ``replica_lost`` instead of looping through
+        every future death."""
+        rid = req.request_id
+        session = self._session_of.pop(rid, None)
+        if session is None:
+            session = rid
+        self._replica_of.pop(rid, None)
+        count = self._failovers.get(rid, 0) + 1
+        self._failovers[rid] = count
+        if count > self.max_failovers or not self.engines:
+            self._terminate_replica_lost(req, generated, count)
+            return None
+        choice, reason = self._route(req, session)
+        try:
+            seq = self.engines[choice].adopt(req, generated=generated)
+        except ValueError as exc:
+            # the salvage cannot run on the target (heterogeneous
+            # fleet: prompt+generated outgrows its pool) — typed
+            # terminal, never an exception out of the fleet loop
+            logger.error(
+                "failover: request %r cannot be adopted by %r (%s)",
+                rid, choice, exc,
+            )
+            self._terminate_replica_lost(req, generated, count,
+                                         why=str(exc))
+            return None
+        self.stats["failovers"] += 1
+        if reason != "affinity":
+            self.stats["overflow_routed"] += 1
+        self._record_assignment(rid, session, choice)
+        logger.warning(
+            "failover %d/%d: request %r re-dispatched to %r with %d "
+            "generated token(s) carried (%s)",
+            count, self.max_failovers, rid, choice, len(generated),
+            reason,
+        )
+        return None if seq.done else choice
+
+    def _terminate_replica_lost(self, req, generated, count, why=None):
+        from unicore_tpu.serve.engine import ServeResult
+
+        if why is None:
+            why = ("no live replica remains" if not self.engines else
+                   f"outlived max_failovers={self.max_failovers} replicas")
+        logger.error(
+            "request %r terminated 'replica_lost' after %d replica "
+            "death(s): %s", req.request_id, count, why,
+        )
+        self.stats["replica_lost"] += 1
+        self._settle_result(ServeResult(
+            request_id=req.request_id, prompt=list(req.prompt),
+            tokens=list(generated), finish_reason="replica_lost",
+            ttft_ms=None, evictions=0,
+        ))
+
+    # -- circuit-breaker rejoin -----------------------------------------
+
+    def _tick_breakers(self):
+        """Launch half-open probes for every OPEN breaker whose
+        cooldown has elapsed and that is not flap-quarantined.  No-op
+        without a replacement ``factory``."""
+        if self.factory is None:
+            return
+        for rid in sorted(self._breakers):
+            if rid in self.engines or rid in self._probation:
+                continue
+            if self._breakers[rid].ready(self._fleet_step):
+                self._start_probation(rid)
+
+    def _start_probation(self, rid):
+        """Boot ``factory(rid)`` OFF the ring and feed it one canary
+        request; only a completed canary closes the breaker and
+        restores the ring mapping (half-open probe)."""
+        from unicore_tpu.serve.scheduler import Request
+
+        breaker = self._breakers[rid]
+        breaker.probe(self._fleet_step)
+        try:
+            eng = self.factory(rid)
+            canary_id = f"canary-{rid}-{breaker.attempts}"
+            eng.submit([Request(prompt=[1], max_new_tokens=1, seed=0,
+                                request_id=canary_id)])
+        except Exception as exc:  # noqa: BLE001 - a bad factory must not kill the fleet
+            logger.error("probe factory for replica %r failed: %r",
+                         rid, exc)
+            breaker.fail(self._fleet_step)
+            return
+        self._probation[rid] = {
+            "engine": eng, "canary": canary_id,
+            "since": self._fleet_step,
+        }
+        logger.warning(
+            "replica %r HALF-OPEN: probing replacement with canary %r "
+            "(attempt %d)", rid, canary_id, breaker.attempts,
+        )
+
+    def _step_probation(self):
+        """Advance every half-open canary one step (off-ring, guarded
+        like any replica step).  A completed canary rejoins the
+        replica; a crash, a failed finish, or a blown probe budget
+        trips the breaker again."""
+        busy = False
+        for rid in sorted(self._probation):
+            probe = self._probation[rid]
+            eng = probe["engine"]
+            try:
+                eng.serve_step()
+                done = {r.request_id: r for r in eng.collect_finished()}
+            except Exception as exc:  # noqa: BLE001 - probe fault stays in the probe
+                self.health.record_exception(rid, exc,
+                                             step=self._fleet_step)
+                self._fail_probation(
+                    rid, f"canary crashed: {type(exc).__name__}: {exc}")
+                continue
+            res = done.get(probe["canary"])
+            if res is not None:
+                if res.finish_reason in ("eos", "length"):
+                    self._rejoin(rid)
+                else:
+                    self._fail_probation(
+                        rid, f"canary finished {res.finish_reason!r}")
+                continue
+            if self._fleet_step - probe["since"] > self.probe_budget_steps:
+                self._fail_probation(
+                    rid, f"canary made no progress within "
+                         f"{self.probe_budget_steps} fleet steps")
+                continue
+            busy = True  # canary in flight keeps the fleet stepping
+        return busy
+
+    def _fail_probation(self, rid, why):
+        self._probation.pop(rid)
+        self._breakers[rid].fail(self._fleet_step)
+        quarantined = self._breakers[rid].quarantined(self._fleet_step)
+        logger.error(
+            "replica %r probe FAILED (%s): breaker re-opens%s",
+            rid, why,
+            " and the slot is flap-QUARANTINED" if quarantined else "",
+        )
+
+    def _rejoin(self, rid):
+        """Full ring rejoin after a completed canary: fresh child,
+        fresh health history, breaker closed.  Minimal-remap means the
+        replica's old sessions come straight back to it — warm prefix
+        pages and all, on a recovered (rather than replaced) engine."""
+        probe = self._probation.pop(rid)
+        eng = probe["engine"]
+        child = self._make_child(rid)
+        eng.shutdown = child
+        self._children[rid] = child
+        self.engines[rid] = eng
+        self.ring.add(rid)
+        self.health.reset(rid)
+        self._breakers[rid].succeed(self._fleet_step)
+        self.stats["rejoins"] += 1
+        logger.warning(
+            "replica %r REJOINED the ring at fleet step %d (canary "
+            "completed; breaker closed)", rid, self._fleet_step,
+        )
+
     # -- rolling restart ------------------------------------------------
 
     def rolling_restart(self, factory=None, *, signum=_signal.SIGTERM,
@@ -243,7 +565,9 @@ class FleetRouter:
         Returns the per-replica drain reports."""
         reports = {}
         for rid in sorted(self.engines):
-            eng = self.engines[rid]
+            eng = self.engines.get(rid)
+            if eng is None:
+                continue  # evicted by failover while an earlier victim drained
             self.ring.remove(rid)
             rerouted = eng.reclaim_waiting()
             for req in rerouted:
@@ -255,7 +579,7 @@ class FleetRouter:
                 self.stats["rerouted"] += 1
             self._children[rid].request(signum)
             steps = 0
-            while eng.has_work():
+            while eng.has_work() and rid in self.engines:
                 # step the FLEET, not just the victim: the rerouted
                 # requests make progress while the victim drains
                 self.step()
@@ -266,7 +590,13 @@ class FleetRouter:
                         f"replica {rid!r} did not drain within "
                         f"{max_steps} fleet steps"
                     )
-            eng.serve_step()  # idle call finalizes the drain report
+            if rid not in self.engines:
+                # the victim died MID-DRAIN: failover already salvaged
+                # its queues and tripped its breaker — the planned
+                # restart for this replica is moot
+                reports[rid] = None
+                continue
+            self._step_replica(rid)  # idle call finalizes the drain report
             reports[rid] = eng.drain_report
             if not eng.pool.is_idle():
                 raise RuntimeError(
@@ -282,6 +612,7 @@ class FleetRouter:
                 self.engines[rid] = new_eng
             else:
                 eng.reopen()
+            self.health.reset(rid)
             self.ring.add(rid)
             self.stats["restarts"] += 1
             logger.warning(
@@ -304,7 +635,10 @@ class FleetRouter:
         reports = {}
         for rid in sorted(self.engines):
             eng = self.engines[rid]
-            eng.serve_step()  # idle call finalizes a pending report
+            self._step_replica(rid)  # idle call finalizes a pending report
+            if rid not in self.engines:
+                reports[rid] = None  # died on its very last step
+                continue
             rep = eng.drain_report
             if rep is None:
                 signame = None
@@ -321,11 +655,15 @@ class FleetRouter:
 
     # -- aggregate report ----------------------------------------------
 
+    def _watchdog_status(self, eng):
+        return None if eng.watchdog is None else eng.watchdog.status()
+
     def fleet_report(self):
         """ONE report for the whole fleet: per-replica stats rolled up
         (sums for counters, maxes for peaks) plus the router's own
-        routing/affinity counters — the gauge surface dashboards and
-        bench.py consume."""
+        routing/affinity/failover counters and the health + breaker
+        surfaces — the gauge surface dashboards and bench.py
+        consume."""
         agg = {k: 0 for k in SUM_STATS}
         agg.update({k: 0 for k in MAX_STATS})
         for eng in self.engines.values():
@@ -345,4 +683,16 @@ class FleetRouter:
                 str(rid): self.engines[rid].load_snapshot()
                 for rid in sorted(self.engines)
             },
+            "health": {
+                str(rid): dict(
+                    self.health.describe(rid),
+                    watchdog=self._watchdog_status(self.engines[rid]),
+                )
+                for rid in sorted(self.engines)
+            },
+            "lost": {str(rid): dict(rec)
+                     for rid, rec in sorted(self._lost.items())},
+            "breakers": {str(rid): br.describe()
+                         for rid, br in sorted(self._breakers.items())},
+            "probation": sorted(map(str, self._probation)),
         }

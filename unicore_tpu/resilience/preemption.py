@@ -119,13 +119,21 @@ class ChildShutdown:
     the same flag path a delivered signal flips, so the engine's drain
     machinery cannot tell the difference).  :meth:`clear` re-opens the
     replica after its restart; a fleet-wide parent request is NOT
-    clearable from a child — a draining fleet stays draining."""
+    clearable from a child — a draining fleet stays draining.
+
+    :meth:`mark_lost` is the FAILOVER terminal state (ISSUE 14): the
+    router marks a dead replica's child lost when it evicts it without
+    a drain.  A lost child's flag is permanent — ``clear()`` no longer
+    re-opens it — so a wedged engine that later "wakes up" finds its
+    drain flag set and sheds instead of serving stale ring traffic;
+    the replacement replica always gets a FRESH child."""
 
     def __init__(self, parent=None, name=None):
         self.parent = parent
         self.name = name
         self._requested = False
         self._signum = None
+        self.lost = False
 
     @property
     def requested(self):
@@ -145,8 +153,25 @@ class ChildShutdown:
         if signum is not None:
             self._signum = signum
 
+    def mark_lost(self):
+        """Permanently drain this child: the replica it guards was
+        evicted WITHOUT a drain (crash/wedge failover).  The flag can
+        never be cleared again — a zombie replica must shed, not
+        serve."""
+        self.lost = True
+        self._requested = True
+
     def clear(self):
         """Reset the CHILD's own flag (post-restart re-open).  The
-        parent's fleet-wide request, if any, still reads through."""
+        parent's fleet-wide request, if any, still reads through; a
+        LOST child stays drained forever (failover eviction is not a
+        restart — the replacement gets a fresh child)."""
+        if self.lost:
+            logger.warning(
+                "ChildShutdown.clear() on lost replica %r ignored — a "
+                "failed-over replica cannot re-open its own drain flag",
+                self.name,
+            )
+            return
         self._requested = False
         self._signum = None

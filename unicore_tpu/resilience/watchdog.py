@@ -98,6 +98,19 @@ class StepWatchdog:
             self._phase = None
             self._deadline = None
 
+    def status(self):
+        """Live arm state for OTHER diagnostics contexts: the fleet
+        router's watchdog context includes each engine watchdog's
+        status so a fleet-level timeout dump names which replica's
+        dispatch was armed, for how long, and whether it already
+        fired."""
+        with self._lock:
+            phase, deadline = self._phase, self._deadline
+        waited = None
+        if deadline is not None:
+            waited = round(self.timeout - (deadline - time.monotonic()), 3)
+        return {"phase": phase, "waited_s": waited, "fired": self.fired}
+
     # -- the watcher thread --------------------------------------------
 
     def _ensure_thread(self):

@@ -87,6 +87,32 @@ def make_parser():
     flt.add_argument("--sessions", type=int, default=4,
                      help="fleet mode: demo requests are spread over "
                           "this many session keys (affinity groups)")
+    flt.add_argument("--max-failovers", type=int, default=2,
+                     help="fleet failover: how many replica deaths one "
+                          "request may survive (rerouted with its "
+                          "generated tokens carried) before it "
+                          "terminates with the typed reason "
+                          "'replica_lost' (default: 2)")
+    flt.add_argument("--suspect-steps", type=int, default=4,
+                     help="fleet health: fleet steps of frozen "
+                          "progress (replica holds work, retires "
+                          "nothing) before a replica is marked "
+                          "suspect (default: 4)")
+    flt.add_argument("--progress-budget-steps", type=int, default=8,
+                     help="fleet health: fleet steps of frozen "
+                          "progress before a wedged replica is "
+                          "declared DEAD and evicted without a drain "
+                          "(default: 8)")
+    flt.add_argument("--breaker-cooldown", type=int, default=8,
+                     help="circuit breaker: fleet steps after an "
+                          "eviction before a replacement replica may "
+                          "probe for rejoin via one canary request "
+                          "(default: 8)")
+    flt.add_argument("--flap-limit", type=int, default=3,
+                     help="circuit breaker: this many trips inside the "
+                          "flap window hold the replica slot "
+                          "quarantined — a flapping replica cannot "
+                          "thrash the ring (default: 3)")
     rob = p.add_argument_group(
         "robustness (docs/serving.md#robustness)")
     rob.add_argument("--max-waiting", type=int, default=None,
@@ -231,11 +257,13 @@ def _fleet_main(args, model, params, requests, shutdown):
     --sessions}``), drive the fleet to completion, then drain every
     replica cleanly — the report must show zero leaked pages on EVERY
     pool and one drain record per replica (the CI smoke asserts it)."""
+    from unicore_tpu.fleet.health import CircuitBreaker, ReplicaHealth
     from unicore_tpu.fleet.router import FleetRouter
     from unicore_tpu.serve.engine import ServeEngine
 
-    engines = {
-        f"r{i}": ServeEngine(
+    def make_engine(rid):
+        del rid
+        return ServeEngine(
             model, params, num_pages=args.num_pages,
             page_size=args.page_size, max_batch=args.max_batch,
             prefill_token_budget=args.prefill_token_budget,
@@ -247,9 +275,25 @@ def _fleet_main(args, model, params, requests, shutdown):
             step_timeout=args.step_timeout,
             progress_path=args.progress_file,
         )
-        for i in range(max(1, args.replicas))
-    }
-    router = FleetRouter(engines, shutdown=shutdown)
+
+    engines = {f"r{i}": make_engine(f"r{i}")
+               for i in range(max(1, args.replicas))}
+    router = FleetRouter(
+        engines, shutdown=shutdown,
+        # failover (docs/serving.md#failover-runbook): dead replicas
+        # are evicted + replaced through the circuit breaker's canary
+        # probe; the same engine recipe serves as the replacement
+        factory=make_engine,
+        max_failovers=args.max_failovers,
+        health=ReplicaHealth(
+            suspect_steps=args.suspect_steps,
+            dead_steps=args.progress_budget_steps,
+        ),
+        breaker=lambda rid: CircuitBreaker(
+            cooldown_steps=args.breaker_cooldown,
+            flap_limit=args.flap_limit,
+        ),
+    )
     logger.info(
         "fleet: %d request(s) over %d session(s) into %d replica(s) "
         "(pool %d pages x %d slots each, max batch %d)",
@@ -274,7 +318,9 @@ def _fleet_main(args, model, params, requests, shutdown):
             rid: {
                 "stats": {k: (round(v, 4) if isinstance(v, float) else v)
                           for k, v in engines[rid].stats.items()},
-                "drain": drains[rid],
+                # a replica evicted by failover has no drain record —
+                # the fleet report's "lost" section carries its story
+                "drain": drains.get(rid),
                 "pool_clean": engines[rid].pool.is_idle(),
             }
             for rid in sorted(engines)

@@ -90,7 +90,9 @@ class ServeResult:
     request_id: Optional[str]
     prompt: List[int]
     tokens: List[int]          # generated tokens (eos included if hit)
-    # "eos" | "length" | "capacity" | "expired" | "shed" | "failed"
+    # "eos" | "length" | "capacity" | "expired" | "shed" | "failed" —
+    # plus "replica_lost", synthesized by the FLEET router (never by an
+    # engine) when a request exhausts max_failovers replica deaths
     finish_reason: str
     ttft_ms: Optional[float]   # None when no token was ever emitted
     evictions: int
@@ -597,7 +599,25 @@ class ServeEngine:
         fleet router's admission path — pair with :meth:`serve_step`
         and :meth:`collect_finished`.  A bounded queue may shed some of
         them immediately; the shed sequences come back terminal."""
-        sched = self.scheduler
+        self._validate_requests(requests)
+        seqs = [self._enqueue(req) for req in requests]
+        if self.scheduler.num_shed:
+            self._sync_lifecycle_stats()
+            metrics.log_scalar("serve/shed", self.scheduler.num_shed)
+        return seqs
+
+    def _enqueue(self, req, generated=None):
+        """One validated request into the scheduler (may shed
+        immediately — bounded queue) with the shared bookkeeping:
+        enqueue stamp on the engine clock, peak-waiting gauge."""
+        seq = self.scheduler.add(req, generated=generated)
+        seq.enqueued_at = self._clock()
+        self.stats["peak_waiting"] = max(
+            self.stats["peak_waiting"], len(self.scheduler.waiting)
+        )
+        return seq
+
+    def _validate_requests(self, requests):
         # validate EVERYTHING before enqueuing anything: a mid-list
         # reject must not leave earlier requests queued as ghost work
         # for the next generate()/submit() call
@@ -623,18 +643,25 @@ class ServeEngine:
                 raise ValueError(
                     f"deadline_ms must be > 0, got {req.deadline_ms!r}"
                 )
-        seqs = []
-        for req in requests:
-            seq = sched.add(req)  # may shed immediately (bounded queue)
-            seq.enqueued_at = self._clock()
-            self.stats["peak_waiting"] = max(
-                self.stats["peak_waiting"], len(sched.waiting)
-            )
-            seqs.append(seq)
-        if sched.num_shed:
+
+    def adopt(self, request, generated=None):
+        """Enqueue one request SALVAGED from a dead replica together
+        with the tokens it already generated there (the fleet router's
+        failover path).  The sequence enters exactly like a preempted
+        requeue: admission re-prefills ``prompt + generated`` — with a
+        warm prefix cache most of that re-prefill is page-table
+        lookups — and absolute-step sampling keys continue the stream
+        token-identically from where the dead replica stopped.  The
+        deadline TTL restamps from THIS enqueue (the request already
+        survived a replica loss; ``max_failovers`` bounds its total
+        lifetime instead).  A bounded queue may shed it immediately;
+        the shed sequence comes back terminal."""
+        self._validate_requests([request])
+        seq = self._enqueue(request, generated=generated)
+        if self.scheduler.num_shed:
             self._sync_lifecycle_stats()
-            metrics.log_scalar("serve/shed", sched.num_shed)
-        return seqs
+            metrics.log_scalar("serve/shed", self.scheduler.num_shed)
+        return seq
 
     def generate(self, requests) -> List[ServeResult]:
         """Run a batch of :class:`Request`s to completion; results come
@@ -966,7 +993,16 @@ class ServeEngine:
         ``prefix_hits`` (int), ``prefix_tokens_saved`` (int),
         ``prefix_hit_rate`` (float, hits/lookups, 0.0 before the first
         lookup) — how much the router's session affinity is paying
-        off on this replica."""
+        off on this replica.
+
+        Health surface (ISSUE 14): ``last_progress`` (int) is the
+        retired-token watermark — the monotonic count of tokens this
+        replica has ever emitted; a replica holding work whose
+        watermark does not advance for the router's progress budget is
+        WEDGED, whatever its queues claim.  ``host_faults`` (int) is
+        the monotonic host-fault counter; the router differences it
+        per fleet step, and a burst over its fault window marks the
+        replica dead before a wedge would."""
         sched = self.scheduler
         recent = list(self.decode_ms)[-33:]
         step_ms = float(sorted(recent)[len(recent) // 2]) if recent else 0.0
@@ -985,20 +1021,49 @@ class ServeEngine:
             "prefix_hits": int(ps["hits"]),
             "prefix_tokens_saved": int(ps["tokens_saved"]),
             "prefix_hit_rate": round(float(hit_rate), 4),
+            "last_progress": int(self.stats["generated_tokens"]),
+            "host_faults": int(self.stats["host_faults"]),
         }
 
-    def reclaim_waiting(self):
+    def reclaim_waiting(self, *, include_running=False):
         """Detach and return every WAITING request (rolling restart:
         the router reroutes them to other replicas before this one
         drains).  Waiting sequences hold no pool pages, so nothing
         leaks; a reclaimed request re-runs from scratch elsewhere, and
         absolute-step-keyed sampling makes the re-run token-identical
         — even for a preempted sequence whose generated tokens are
-        simply regenerated."""
+        simply regenerated.
+
+        ``include_running=True`` is the FAILOVER salvage (the router's
+        dead-replica eviction): RUNNING sequences are force-detached
+        too, and the return value becomes ``[(Request, generated), …]``
+        pairs — running first (they carry sunk decode work, mirroring
+        the preemption requeue-at-front priority), then waiting in
+        queue order — so a healthy replica can :meth:`adopt` each one
+        and re-prefill prompt+generated instead of re-decoding.  Page
+        frees on the dead pool are best-effort: the replica is leaving
+        the fleet, its pool dies with it."""
         sched = self.scheduler
-        reqs = [seq.req for seq in sched.waiting]
+        if not include_running:
+            reqs = [seq.req for seq in sched.waiting]
+            sched.waiting.clear()
+            return reqs
+        salvaged = []
+        for seq in list(sched.running):
+            salvaged.append((seq.req, list(seq.generated)))
+            sched.running.remove(seq)
+            try:
+                self.pool.free(seq.sid)
+            except Exception as e:  # noqa: BLE001 - dying pool, best effort
+                logger.warning(
+                    "failover salvage: freeing %r on the dead replica's "
+                    "pool failed (%s) — the pool leaves with the replica",
+                    seq.sid, e,
+                )
+        salvaged.extend((seq.req, list(seq.generated))
+                        for seq in sched.waiting)
         sched.waiting.clear()
-        return reqs
+        return salvaged
 
     def reopen(self):
         """Re-open admission after a COMPLETED drain — the fleet

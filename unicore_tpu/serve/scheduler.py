@@ -152,18 +152,31 @@ class Scheduler:
 
     # -- queue management ---------------------------------------------
 
-    def add(self, req):
+    def add(self, req, *, generated=None):
         """Enqueue a request; rejects requests that could NEVER run
         (a prompt alone outgrowing the pool) instead of livelocking the
         eviction loop on them later.  Generation beyond the pool is NOT
         rejected — the engine truncates those with a "capacity" finish,
-        so a sequence's live KV never exceeds what a solo run fits."""
-        need = self.pool.pages_for(len(req.prompt))
+        so a sequence's live KV never exceeds what a solo run fits.
+
+        ``generated``: tokens the request already produced ELSEWHERE (a
+        failed-over sequence salvaged from a dead replica, engine
+        :meth:`~unicore_tpu.serve.engine.ServeEngine.adopt`).  The
+        sequence enqueues exactly like a preempted requeue: admission
+        re-prefills prompt+generated and the absolute-step sampling
+        keys continue the stream token-identically.  The could-never-
+        run guard covers the FULL re-prefill prefix — on a
+        heterogeneous fleet a salvaged prompt+generated that outgrows
+        THIS pool must be rejected here, not pinned at waiting[0]
+        failing can_alloc forever."""
+        prefix_len = len(req.prompt) + len(generated or ())
+        need = self.pool.pages_for(prefix_len)
         if need > self.pool.num_usable_pages:
             raise ValueError(
-                f"prompt needs {need} pages for {len(req.prompt)} "
-                f"tokens; the pool holds {self.pool.num_usable_pages} — "
-                "raise num_pages or shorten the prompt"
+                f"prefix needs {need} pages for {prefix_len} tokens "
+                f"({len(req.prompt)} prompt); the pool holds "
+                f"{self.pool.num_usable_pages} — raise num_pages or "
+                "shorten the prompt"
             )
         if not req.prompt:
             raise ValueError("empty prompt")
@@ -179,6 +192,8 @@ class Scheduler:
             )
         seq = Sequence(self._next_sid, req)
         self._next_sid += 1
+        if generated:
+            seq.generated = list(generated)
         # free decode slots count as headroom: a bound that shed while
         # the batch sat idle would throttle capacity, not overload.
         # Saturated (running == max_batch) the bound is exactly
