@@ -74,6 +74,8 @@ def _build_trainer(cfg):
         rng_impl="rbg",
         fp16=cfg.get("fp16", False), bf16=not cfg.get("fp16", False),
         bf16_sr=False,
+        zero1=cfg.get("zero1", False),
+        optim_bf16_moments=cfg.get("optim_bf16_moments", False),
         optimizer="adam", lr=[1e-4], adam_betas="(0.9, 0.98)",
         adam_eps=1e-8, weight_decay=0.01,
         lr_scheduler="fixed", force_anneal=None, lr_shrink=0.1,
@@ -953,6 +955,132 @@ def _input_stall_micro(out):
     return out["input_stall_ms"]
 
 
+def _zero1_child_main():
+    """``BENCH_ZERO1_CHILD=1`` subprocess entry: ZeRO-1 vs plain dp on a
+    virtual 8-device CPU mesh (the parent process may hold a 1-device
+    backend, and XLA device count is fixed at first init — same
+    subprocess pattern as the chaos harness).  Prints one JSON line:
+    per-replica optimizer-state bytes for both recipes and the paired
+    step-time ratio (reduce-scatter + update all-gather vs plain dp
+    all-reduce)."""
+    import numpy as np
+
+    import jax
+
+    from unicore_tpu import metrics as _metrics
+    from unicore_tpu.distributed import utils as dist_utils
+
+    cfg = dict(batch=8, steps=10, warmup=4, seq=64, vocab=4096,
+               layers=2, dim=64, ffn=128, heads=2)
+    out = {"devices": jax.device_count()}
+    sides = {}
+    for key, extra in (
+        ("dp", {}),
+        ("zero1", {"zero1": True, "optim_bf16_moments": True}),
+    ):
+        dist_utils.reset_mesh()
+        trainer, d, mask_idx = _build_trainer(dict(cfg, fp16=False, **extra))
+        rng = np.random.RandomState(0)
+        batch = _make_batch(rng, d, mask_idx, cfg["batch"], cfg["seq"])
+        with _metrics.aggregate("train"):
+            for _ in range(cfg["warmup"]):
+                trainer.train_step([batch])
+            trainer.flush_stats()
+        # per-replica optimizer-state bytes: one device's shard of every
+        # moment leaf (shard_shape is pure metadata — no fetch)
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(trainer.state["opt_state"]):
+            if not getattr(leaf, "ndim", 0):
+                continue  # the step scalar
+            shard = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(shard)) * leaf.dtype.itemsize
+        out[f"optim_bytes_per_replica_{key}"] = total
+
+        def measure(trainer=trainer, batch=batch):
+            with _metrics.aggregate("train"):
+                t0 = time.perf_counter()
+                for _ in range(cfg["steps"]):
+                    trainer.train_step([batch])
+                trainer.flush_stats()
+            return (time.perf_counter() - t0) / cfg["steps"]
+
+        sides[key] = measure
+    # paired alternating windows (the _pipeline_micro drift-cancelling
+    # protocol): each ratio's two sides run within one ~2-window span
+    ratios = []
+    for p in range(8):
+        if p % 2 == 0:
+            t_dp = sides["dp"]()
+            t_z = sides["zero1"]()
+        else:
+            t_z = sides["zero1"]()
+            t_dp = sides["dp"]()
+        ratios.append(t_z / t_dp)
+    ratios.sort()
+    out["zero1_step_overhead_ratio"] = round(ratios[len(ratios) // 2], 3)
+    out["zero1_optim_bytes_ratio"] = round(
+        out["optim_bytes_per_replica_zero1"]
+        / max(out["optim_bytes_per_replica_dp"], 1), 4,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+def _zero1_micros(out):
+    """ZeRO-1 weight-update sharding + bf16 SR moments (ISSUE 15).
+
+    ``zero1_optim_bytes_per_replica`` vs the replicated dp baseline
+    (expect ~1/N from the data-axis sharding, then ~half again from the
+    bf16 moment store, diluted by the deliberately-replicated 1-D
+    leaves), ``zero1_step_overhead_ratio`` (reduce-scatter + update
+    all-gather cost vs plain dp all-reduce on the 8-device CPU mesh),
+    and ``optim_sr_cast_speedup`` (the dispatched fp32->bf16 SR cast vs
+    the jnp reference at the tuner-preset moment size)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_ZERO1_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"zero1 child rc={proc.returncode}: {proc.stderr[-1500:]}"
+        )
+    child = json.loads(lines[-1])
+    out["zero1_optim_bytes_per_replica"] = child[
+        "optim_bytes_per_replica_zero1"]
+    out["zero1_optim_bytes_per_replica_dp"] = child[
+        "optim_bytes_per_replica_dp"]
+    out["zero1_optim_bytes_ratio"] = child["zero1_optim_bytes_ratio"]
+    out["zero1_step_overhead_ratio"] = child["zero1_step_overhead_ratio"]
+    out["zero1_mesh_devices"] = child["devices"]
+
+    # SR cast A/B in THIS process (no mesh dependency): reference jnp
+    # composition vs the dispatched op (autotune verdict / use_pallas
+    # gate) at the committed tuner-preset moment size
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.ops import rounding as _rnd
+    from unicore_tpu.ops import tuning as _tuning
+
+    n = 768 * 768
+    x = jnp.zeros((n,), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    t_ref = _timed(jax.jit(_rnd.fp32_to_bf16_sr_reference), x, key)
+    t_disp = _timed(jax.jit(_rnd.fp32_to_bf16_sr), x, key)
+    out["optim_sr_cast_speedup"] = round(t_ref / t_disp, 3)
+    out["optim_sr_cast_decision"] = _tuning.describe_decision(
+        "optim_sr_cast", _tuning.sr_cast_workload(n)
+    )
+    return out["zero1_step_overhead_ratio"]
+
+
 def _fused_ce_micro(out):
     """Fused chunked linear+cross-entropy head vs the materialized
     [rows, vocab] logits path (ISSUE 10), on the shrunk 2x64 trainer
@@ -1182,12 +1310,14 @@ def _microbench(out):
     }
     grads = {k: jnp.asarray(rngp.randn(512, 768), jnp.float32) * 1e-3
              for k in params}
-    state = opt.init(params)
+    # replicated eager state is the POINT of this A/B (fused-vs-eager
+    # update cost on one device, no mesh in play)
+    state = opt.init(params)  # unicore-lint: disable=UL114
     fused = jax.jit(lambda g, s, p: opt.update(g, s, p, lr=1e-4))
     leaf_upd = jax.jit(
         lambda g, s, p: opt.update({"x": g}, s, {"x": p}, lr=1e-4)
     )
-    leaf_states = {k: opt.init({"x": params[k]}) for k in params}
+    leaf_states = {k: opt.init({"x": params[k]}) for k in params}  # unicore-lint: disable=UL114
 
     def eager(grads, states, params):
         return [
@@ -1403,6 +1533,7 @@ def _cpu_tier_main():
         ("step_boundary_host_ms", lambda: _host_overlap_micros(micro)),
         ("input_stall_ms", lambda: _input_stall_micro(micro)),
         ("pipeline_depth_speedup", lambda: _pipeline_micro(micro)),
+        ("zero1_step_overhead_ratio", lambda: _zero1_micros(micro)),
     ):
         _micro_guard(micro, name, fn)
     out = {
@@ -1418,6 +1549,8 @@ def _cpu_tier_main():
 
 
 def main():
+    if os.environ.get("BENCH_ZERO1_CHILD") == "1":
+        return _zero1_child_main()
     if os.environ.get("BENCH_CPU_TIER") == "1":
         return _cpu_tier_main()
     errors = []

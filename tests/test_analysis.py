@@ -489,10 +489,12 @@ def test_fused_head_audit_silent_fused_fires_materialized():
     from unicore_tpu.analysis.scenarios import (
         MESH_VARIANTS,
         PASS3_VARIANTS,
+        ZERO1_VARIANTS,
         audit_fused_head_memory,
     )
 
-    variants = [v for v in MESH_VARIANTS if v[0] in PASS3_VARIANTS]
+    variants = [v for v in MESH_VARIANTS + ZERO1_VARIANTS
+                if v[0] in PASS3_VARIANTS]
     results = audit_fused_head_memory(
         os.path.join(_repo_root(), "examples", "bert"),
         variants=variants, n_devices=8,
@@ -1607,3 +1609,252 @@ def test_dropout_zero_and_one_rates_stay_silent(caplog):
         out = dropout_mod.dropout(x, 1.0, rng)
     np.testing.assert_array_equal(np.asarray(out), np.zeros_like(x))
     assert [r for r in caplog.records if "quantizes" in r.message] == []
+
+
+# ---------------------------------------------------------------------
+# UL201 zero1 certification (ISSUE 15): synthetic units + real compiles
+# ---------------------------------------------------------------------
+
+def test_ul201_zero1_unit_fires_and_stays_silent():
+    from unicore_tpu.analysis.hlo_audit import audit_zero1_collectives
+
+    mesh = _mesh()  # data=8
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}  # 16 KiB leaf
+    data_slab = [range(8)]
+    healthy = [
+        _coll("all-reduce", 16384, data_slab),
+        _coll("all-gather", 20000, data_slab),
+    ]
+    assert audit_zero1_collectives(mesh, healthy, params,
+                                   context="t") == []
+    # reduce-scatter proper (the TPU form) also satisfies the rule
+    rs = [
+        _coll("reduce-scatter", 2048, data_slab),
+        _coll("all-gather", 20000, data_slab),
+    ]
+    assert audit_zero1_collectives(mesh, rs, params, context="t") == []
+    # plain dp signature: data all-reduce but no param-sized gather
+    dead = [_coll("all-reduce", 16384, data_slab)]
+    found = audit_zero1_collectives(mesh, dead, params, context="t")
+    assert rules_of(found) == {"UL201"}
+    assert "zero1-disengaged" in found[0].name
+    # no data reduction at all: both signatures missing
+    none = [_coll("all-gather", 512, data_slab)]
+    found = audit_zero1_collectives(mesh, none, params, context="t")
+    assert len(found) == 2
+    # a tensor-axis gather must not count toward the data signature
+    mesh_tp = _mesh(tensor=2)  # data=4, tensor=2
+    tp_pairs = [(0, 1), (2, 3), (4, 5), (6, 7)]  # vary along tensor
+    tp_only = [
+        _coll("all-reduce", 16384, [(0, 2, 4, 6), (1, 3, 5, 7)]),
+        _coll("all-gather", 20000, tp_pairs),
+    ]
+    found = audit_zero1_collectives(mesh_tp, tp_only, params, context="t")
+    assert rules_of(found) == {"UL201"}
+    # 1-device data axis: --zero1 is a declared no-op, rule silent
+    mesh_1 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:8]).reshape(1, 8, 1, 1),
+        ("data", "fsdp", "seq", "tensor"),
+    )
+    assert audit_zero1_collectives(mesh_1, dead, params, context="t") == []
+
+
+@pytest.fixture(scope="module")
+def zero1_compiled():
+    import os
+
+    from unicore_tpu.analysis.scenarios import (
+        build_bert_scenario,
+        restore_globals,
+        snapshot_globals,
+    )
+
+    snap = snapshot_globals()
+    try:
+        trainer, samples, _ = build_bert_scenario(
+            os.path.join(_repo_root(), "examples", "bert"),
+            {"zero1": True, "optim_bf16_moments": True},
+            jax.devices()[:8],
+        )
+        art = trainer.trace_train_step(samples)
+        compiled = art["lowered"].compile()
+        yield trainer, art, compiled
+    finally:
+        restore_globals(snap)
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_ul201_zero1_silent_on_healthy_compile(zero1_compiled):
+    """ISSUE 15 acceptance: the real --zero1 --optim-bf16-moments
+    compile carries the sharded-update group signature (data-axis
+    reduction + param-sized update all-gathers) and the certifier is
+    silent; the moments really are data-sharded bf16."""
+    from unicore_tpu.analysis import hlo_audit
+
+    trainer, art, compiled = zero1_compiled
+    colls = hlo_audit.extract_collectives(compiled.as_text(), 8)
+    found = hlo_audit.audit_zero1_collectives(
+        trainer.mesh, colls, art["state"]["params"], context="bert/zero1"
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+    for leaf in jax.tree_util.tree_leaves(
+            trainer.state["opt_state"]["exp_avg"]):
+        assert leaf.dtype == jnp.bfloat16
+        if leaf.ndim >= 2:
+            axes = {a for e in leaf.sharding.spec if e
+                    for a in (e if isinstance(e, tuple) else (e,))}
+            assert "data" in axes
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_ul201_zero1_fires_on_disengaged_spec():
+    """The disengaged fixture: a plain-dp compile (moments replicated)
+    audited under a declared --zero1 must fire — the update gathers
+    that prove per-replica sharding are absent."""
+    import os
+
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis.scenarios import (
+        build_bert_scenario,
+        restore_globals,
+        snapshot_globals,
+    )
+
+    snap = snapshot_globals()
+    try:
+        trainer, samples, _ = build_bert_scenario(
+            os.path.join(_repo_root(), "examples", "bert"), {},
+            jax.devices()[:8],
+        )
+        art = trainer.trace_train_step(samples)
+        compiled = art["lowered"].compile()
+        colls = hlo_audit.extract_collectives(compiled.as_text(), 8)
+        found = hlo_audit.audit_zero1_collectives(
+            trainer.mesh, colls, art["state"]["params"],
+            context="bert/zero1-disengaged",
+        )
+        assert "UL201" in rules_of(found), found
+        assert any("zero1-disengaged" in f.name for f in found)
+    finally:
+        restore_globals(snap)
+
+
+def test_committed_zero1_budget_strictly_below_dp():
+    """ISSUE 15 acceptance: the committed UL203 budget pins the zero1
+    scenarios' peak HBM strictly below their replicated baselines for
+    this environment's fingerprint."""
+    import os
+
+    from unicore_tpu.analysis import hlo_audit
+
+    path = os.path.join(_repo_root(), "tools", "comms_baseline.json")
+    budgets = hlo_audit.load_budgets(path)
+    fp = hlo_audit.pass3_fingerprint()
+    section = budgets.get("budgets", {}).get(fp)
+    if not section or "bert/zero1" not in section:
+        pytest.skip(f"no committed budgets for fingerprint {fp}")
+    assert (section["bert/zero1"]["peak_bytes"]
+            < section["bert/dp"]["peak_bytes"])
+    assert (section["bert/zero1_tp2"]["peak_bytes"]
+            < section["bert/tp2"]["peak_bytes"])
+
+
+# ---------------------------------------------------------------------
+# UL114 replicated-optim-state (ISSUE 15)
+# ---------------------------------------------------------------------
+
+def test_ul114_fires_on_bare_init_in_zero1_module(tmp_path):
+    found = _lint_snippet(tmp_path, "tr.py", """
+        import jax
+        class T:
+            def setup(self, args, params):
+                self.zero1 = bool(args.zero1)
+                self.opt_state = self.optimizer.init(params)
+    """)
+    assert "UL114" in rules_of(found)
+
+
+def test_ul114_fires_on_init_allocations(tmp_path):
+    found = _lint_snippet(tmp_path, "opt.py", """
+        import jax
+        import jax.numpy as jnp
+        class Opt:
+            def __init__(self, args):
+                self.zero1 = args.zero1
+            def init(self, params):
+                return jax.tree_util.tree_map(jnp.zeros_like, params)
+    """)
+    assert "UL114" in rules_of(found)
+    found = _lint_snippet(tmp_path, "opt2.py", """
+        import jax
+        import jax.numpy as jnp
+        class Opt:
+            def __init__(self, args):
+                self.zero1 = args.zero1
+            def init(self, params):
+                zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+                return jax.tree_util.tree_map(zeros, params)
+    """)
+    assert "UL114" in rules_of(found)
+
+
+def test_ul114_silent_on_sanctioned_paths(tmp_path):
+    # jit(init, out_shardings=...) — the Trainer._init_opt_state shape
+    found = _lint_snippet(tmp_path, "ok1.py", """
+        import jax
+        class T:
+            def setup(self, args, params, sh):
+                self.zero1 = bool(args.zero1)
+                self.opt_state = jax.jit(
+                    self.optimizer.init, out_shardings=sh)(params)
+    """)
+    assert "UL114" not in rules_of(found)
+    # result wrapped in a sharding constraint
+    found = _lint_snippet(tmp_path, "ok2.py", """
+        import jax
+        class T:
+            def setup(self, args, params, sh):
+                self.zero1 = bool(args.zero1)
+                self.opt_state = jax.lax.with_sharding_constraint(
+                    self.optimizer.init(params), sh)
+    """)
+    assert "UL114" not in rules_of(found)
+    # no zero1 plumbing: replicated moments are just the dp layout
+    found = _lint_snippet(tmp_path, "ok3.py", """
+        import jax
+        import jax.numpy as jnp
+        class Opt:
+            def init(self, params):
+                return jax.tree_util.tree_map(jnp.zeros_like, params)
+        class T:
+            def setup(self, params):
+                self.opt_state = self.optimizer.init(params)
+    """)
+    assert "UL114" not in rules_of(found)
+
+
+def test_ul114_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "sup.py", """
+        import jax
+        class T:
+            def setup(self, args, params):
+                self.zero1 = bool(args.zero1)
+                self.opt_state = self.optimizer.init(params)  # unicore-lint: disable=UL114
+    """)
+    assert "UL114" not in rules_of(found)
+
+
+def test_ul114_repo_sweep_clean():
+    import os
+
+    root = _repo_root()
+    found = [
+        f for f in lint_paths(
+            [os.path.join(root, "unicore_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "tools")],
+            rel_to=root,
+        )
+        if f.rule == "UL114"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)

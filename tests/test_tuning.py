@@ -655,3 +655,74 @@ def test_cli_dry_run_roundtrip(tmp_path, capsys):
     assert {k: v["winner"] for k, v in r1["entries"].items()} == \
         {k: v["winner"] for k, v in r2["entries"].items()}
     assert main(["cache", "--cache", cache, "-q"]) == 0
+
+
+# ---------------------------------------------------------------------
+# optim_sr_cast (ISSUE 15: bf16-moment SR re-quantization)
+# ---------------------------------------------------------------------
+
+def test_sr_cast_bucket_and_candidates():
+    b = candidates.OPS["optim_sr_cast"].bucket
+    # one entry covers a pow2 family of leaf sizes
+    assert b(tuning.sr_cast_workload(500_000)) == \
+        b(tuning.sr_cast_workload(524_288))
+    assert b(tuning.sr_cast_workload(524_288)) != \
+        b(tuning.sr_cast_workload(1_048_576))
+    wl = tuning.sr_cast_workload(768 * 768)
+    cands = candidates.OPS["optim_sr_cast"].candidates(wl)
+    assert cands[0] == "eager" and {"impl": "pallas"} in cands
+    # dry-run shrink keeps the workload well-formed and small
+    small = candidates.OPS["optim_sr_cast"].shrink(wl)
+    assert small["n"] <= 4096 and small["op"] == "optim_sr_cast"
+    assert "optim_sr_cast_moments" in tuning.PRESETS
+
+
+def test_sr_cast_runner_builds_both_candidates(tune_env):
+    """Both candidate runners AOT-compile and preserve value brackets:
+    every output sits within one bf16 ulp of the input (the two impls
+    draw different random streams, so PARITY here is the rounding
+    contract, not bitwise equality)."""
+    wl = candidates.OPS["optim_sr_cast"].shrink(
+        tuning.PRESETS["optim_sr_cast_moments"]
+    )
+    for config in ("eager", {"impl": "pallas"}):
+        fn = candidates.OPS["optim_sr_cast"].build_runner(wl, config)
+        out = np.asarray(fn(), np.float64)
+        assert out.size == wl["n"] or out.size >= wl["n"]
+        assert np.all(np.isfinite(out))
+
+
+def test_sr_cast_cached_verdict_steers_dispatch(tune_env, rng):
+    """A cached "eager" verdict must route ops.fp32_to_bf16_sr to the
+    threefry reference even when the pallas backend is forced."""
+    import jax
+
+    from unicore_tpu.ops import backend as ops_backend
+    from unicore_tpu.ops.rounding import (
+        fp32_to_bf16_sr,
+        fp32_to_bf16_sr_reference,
+    )
+
+    x = jnp.asarray(rng.randn(2048), jnp.float32)
+    key = jax.random.PRNGKey(3)
+    wl = tuning.sr_cast_workload(x.size)
+    bucket = bucket_key(candidates.OPS["optim_sr_cast"].bucket(wl))
+    tune_env.record(bucket, "eager")
+    tuning.reset_memo()
+    prev = ops_backend.get_kernel_backend()
+    try:
+        ops_backend.set_kernel_backend("pallas")
+        got = fp32_to_bf16_sr(x, key)
+    finally:
+        ops_backend.set_kernel_backend(prev)
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32),
+        np.asarray(fp32_to_bf16_sr_reference(x, key), np.float32),
+    )
+
+
+def test_sr_cast_decision_never_raises(tune_env):
+    # with an empty cache every size falls through to the heuristics
+    # (None); odd sizes must never raise out of the dispatch consult
+    for n in (1, 7, 1023, 768 * 768, 10 ** 9):
+        assert tuning.sr_cast_decision(n) is None

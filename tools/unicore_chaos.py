@@ -34,7 +34,17 @@ Fault-injection legs (exercising the in-loop anomaly guard end to end):
                          leg above composes with it, proving the
                          in-flight ring, the lag-K drain, and the
                          rewind's discard+replay keep trajectories,
-                         checkpoints, and the ladder bit-exact.
+                         checkpoints, and the ladder bit-exact;
+  --zero1                run BOTH runs with ZeRO-1 weight-update
+                         sharding + bf16 SR moments (--zero1
+                         --optim-bf16-moments, needs --devices > 1):
+                         the data-axis-sharded bf16 moments must
+                         round-trip atomic_save/restore shard files
+                         bit-exactly across the kill, and composed
+                         with --inject nonfinite:K the guard's
+                         where-bypass skip must leave the SHARDED
+                         moments bit-untouched (every later loss
+                         matches the oracle carrying the same skip).
 
 Serve-tier legs (``--serve``, ISSUE 7 — the same oracle discipline
 applied to the continuous-batching engine):
@@ -104,8 +114,9 @@ into the data layer, docs/fault_tolerance.md "Input pipeline"):
 
 CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
 (SIGKILL at a random step + one torn shard + bit-exact resume), the
-``--inject nonfinite:4`` leg, the serve poison + graceful + flood legs,
-the four fleet legs (``--rolling``, ``--kill-replica``,
+``--inject nonfinite:4`` leg, the ``--zero1 --devices 2`` SIGKILL-resume
+and ``--zero1 --inject nonfinite:4`` legs, the serve poison + graceful +
+flood legs, the four fleet legs (``--rolling``, ``--kill-replica``,
 ``--wedge-replica``, ``--flap``), and the ``--data corrupt:2`` +
 ``--data hang`` legs.  Exit code 0 iff every assertion holds.
 """
@@ -175,6 +186,10 @@ def train_cmd(args, data_dir, save_dir, traj_file, extra=None):
     ]
     if args.fsdp_size > 1:
         cmd += ["--fsdp-size", str(args.fsdp_size)]
+    if getattr(args, "zero1", False):
+        # the full production recipe: data-axis moment sharding + bf16
+        # SR moments — the kill/skip legs prove both round-trip exactly
+        cmd += ["--zero1", "--optim-bf16-moments"]
     if extra:
         cmd += list(extra)  # argparse: the LAST occurrence of a flag wins
     return cmd
@@ -1443,6 +1458,14 @@ def build_parser():
     p.add_argument("--fsdp-size", type=int, default=1,
                    help="fsdp axis of the victim runs (>1 produces the "
                         ".shard files --corrupt shard tears)")
+    p.add_argument("--zero1", action="store_true",
+                   help="run BOTH runs with --zero1 --optim-bf16-moments "
+                        "(ZeRO-1 data-axis moment sharding + bf16 SR "
+                        "moments; needs --devices > 1 for the sharding "
+                        "to engage): sharded bf16 moments must survive "
+                        "the SIGKILL-resume bit-exactly, and with "
+                        "--inject nonfinite:K the guard's skip must "
+                        "leave them bit-untouched")
     p.add_argument("--corrupt", choices=("none", "shard", "main"),
                    default="none",
                    help="after the kill, tear the newest checkpoint "
@@ -1549,6 +1572,12 @@ def main(argv=None):
             "--writer-fail and --graceful are exclusive: the injected IO "
             "failure must bring the run down by itself"
         )
+    if args.zero1 and args.devices < 2:
+        raise SystemExit(
+            "--zero1 needs --devices > 1: on a 1-device data axis the "
+            "sharding is a no-op and the leg would pass vacuously "
+            "while reporting zero1:true"
+        )
     workdir = args.workdir or tempfile.mkdtemp(prefix="unicore_chaos_")
     os.makedirs(workdir, exist_ok=True)
     rng = random.Random(args.seed)
@@ -1562,6 +1591,7 @@ def main(argv=None):
         "kill_in_write": bool(args.kill_in_write),
         "writer_fail": int(args.writer_fail),
         "pipeline_depth": int(args.pipeline_depth),
+        "zero1": bool(args.zero1),
     }
     # pipelined legs: the ORACLE is pinned to the strict serial loop
     # (K=1, lag 0 — the pre-pipeline semantics the ladder contract is

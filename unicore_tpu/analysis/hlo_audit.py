@@ -325,6 +325,82 @@ def audit_fsdp_collectives(mesh, collectives, params, *, context,
     return findings
 
 
+def audit_zero1_collectives(mesh, collectives, params, *, context):
+    """UL201 over a compiled step that DECLARES ZeRO-1 weight-update
+    sharding (``--zero1``): certify the sharded-update group signature.
+
+    A healthy ZeRO-1 program shows two structures over the **data**
+    axis (arxiv 2004.13336):
+
+    - a float gradient reduction whose replica groups are data-axis
+      slabs — a ``reduce-scatter`` proper, or XLA:CPU's
+      all-reduce+slice emulation (the same CPU caveat as the fsdp
+      rule: group STRUCTURE is the discriminator, not the op name);
+    - param-scale float ``all-gather``s whose groups span the data
+      axis — the updated 1/N slices gathered back into the replicated
+      params.  Plain dp never moves weight-sized float buffers between
+      data replicas (they hold identical state), so the gathers are
+      the signature that each replica really updated only its shard.
+
+    Their absence means the spec disengaged: the moments replicated
+    despite ``--zero1`` and every replica ran the full update."""
+    import numpy as np
+
+    import jax
+
+    extent = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if extent.get("data", 1) <= 1:
+        return []  # nothing shardable: --zero1 is a declared no-op
+    coords = _device_coords(mesh)
+    location = f"hlo:{context}"
+    findings = []
+
+    def data_slab(c):
+        """Every replica group of ``c`` is a data-axis slab (fixed on
+        all other axes, spanning >= 2 data coordinates)."""
+        return all(
+            _varies_only_along(g, coords, ("data",))
+            and _group_axis_span(g, coords, "data") >= 2
+            for g in c.groups
+        )
+
+    reduced = any(
+        c.is_float and c.groups
+        and c.kind in ("reduce-scatter", "all-reduce")
+        and data_slab(c)
+        for c in collectives
+    )
+    leaf_bytes = [
+        int(np.prod(l.shape, dtype=np.int64)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(params)
+        if hasattr(l, "shape") and l.shape
+    ]
+    weight_scale = max(leaf_bytes, default=0)
+    gather_bytes = sum(
+        c.bytes for c in collectives
+        if c.kind == "all-gather" and c.is_float and c.groups
+        and data_slab(c)
+    )
+    if not reduced:
+        findings.append(Finding(
+            "UL201", "zero1-disengaged", "error", location,
+            f"--zero1 declared on a data axis of size {extent['data']} "
+            f"but no float reduction's replica groups are data-axis "
+            f"slabs — gradients never reduce into per-replica shards",
+        ))
+    if weight_scale and gather_bytes < weight_scale:
+        findings.append(Finding(
+            "UL201", "zero1-disengaged", "error", location,
+            f"--zero1 declared on a data axis of size {extent['data']} "
+            f"but the compiled step all-gathers only {gather_bytes} "
+            f"float bytes across data replicas (largest param leaf: "
+            f"{weight_scale}) — the param-sized update gather is "
+            f"missing, so the optimizer state replicated and every "
+            f"replica ran the full weight update",
+        ))
+    return findings
+
+
 # ---------------------------------------------------------------------
 # UL202 / UL203 — budgets
 # ---------------------------------------------------------------------

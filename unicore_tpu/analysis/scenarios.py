@@ -31,10 +31,22 @@ MESH_VARIANTS = (
     ("tp2_fsdp2", {"tensor_parallel_size": 2, "fsdp_size": 2}, 4),
 )
 
+# ZeRO-1 weight-update sharding variants (ISSUE 15): Pass-3-only — the
+# structural hazards Pass 1 hunts are covered by the base meshes, but
+# the compiled GROUP signature (reduce-scatter over data + param-sized
+# update all-gathers, certified by UL201's zero1 rule) only exists in
+# the optimized HLO.  Both run the production recipe: bf16 SR moments
+# on top of the data-axis moment sharding.
+ZERO1_VARIANTS = (
+    ("zero1", {"zero1": True, "optim_bf16_moments": True}, 2),
+    ("zero1_tp2", {"zero1": True, "optim_bf16_moments": True,
+                   "tensor_parallel_size": 2}, 4),
+)
+
 # Pass 3 compiles (not just traces) each variant, so the set is the
 # bench-relevant subset: seq2's ring shard_map collectives are pinned by
 # tests/test_parallel.py already and its compile is the slowest.
-PASS3_VARIANTS = ("dp", "fsdp2", "tp2", "tp2_fsdp2")
+PASS3_VARIANTS = ("dp", "fsdp2", "tp2", "tp2_fsdp2", "zero1", "zero1_tp2")
 
 # UL204 match pairs: (group name, [(scenario suffix, overrides,
 # micro-batches to feed), ...]) — members must compile to the same
@@ -60,6 +72,7 @@ def base_args(**overrides):
         warmup_updates=0, min_loss_scale=1e-4, fp16_scale_window=None,
         fp16_init_scale=4.0, max_update=10, max_epoch=0,
         tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
+        zero1=False, optim_bf16_moments=False,
         # the audited program is the PRODUCTION default (fused chunked
         # LM head) — with an explicit small chunk so the scan is real at
         # audit shapes (the auto heuristic would take the unfused path
@@ -240,7 +253,8 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
     tol = hlo_audit.DEFAULT_TOLERANCE if tolerance is None else tolerance
 
     wanted = tuple(variants or PASS3_VARIANTS)
-    variant_map = {name: (ov, mind) for name, ov, mind in MESH_VARIANTS}
+    variant_map = {name: (ov, mind)
+                   for name, ov, mind in MESH_VARIANTS + ZERO1_VARIANTS}
     unknown = [v for v in wanted if v not in variant_map]
     if unknown:
         raise ValueError(
@@ -273,6 +287,14 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                 params=art["state"]["params"], num_devices=len(devices),
             )
             findings.extend(got)
+            if overrides.get("zero1"):
+                # certify the sharded-update group signature (and fire
+                # when the spec disengaged — moments replicated despite
+                # --zero1)
+                findings.extend(hlo_audit.audit_zero1_collectives(
+                    trainer.mesh, colls, art["state"]["params"],
+                    context=ctx,
+                ))
             scenario_stats[ctx] = stats
             colls_by_scenario[ctx] = colls
             scenarios_report.append({"scenario": ctx, **stats})

@@ -97,6 +97,19 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   the health model so a dead replica is evicted and its sessions fail
   over (``fleet/router.py`` ``FleetRouter._step_replica``).
 
+- UL114 replicated-optim-state: in a module that plumbs the trainer's
+  ``zero1`` flag, optimizer state created OUTSIDE a sharding-constraint
+  context — a bare ``<optimizer>.init(params)`` call, or a full-shape
+  moment allocation (``jnp.zeros_like(param)`` / ``jnp.zeros(p.shape)``)
+  inside a function named ``init``.  Under ``--zero1`` the moments must
+  be *created* data-axis-sharded (``jax.jit(opt.init,
+  out_shardings=...)``, the ``Trainer._init_opt_state`` path, or a
+  ``with_sharding_constraint``/``device_put`` wrapper): an unconstrained
+  init materializes the full replicated fp32 moment tree on every
+  replica first, which is precisely the peak allocation ZeRO-1 exists
+  to avoid.  Modules that never see the flag are exempt — without
+  ZeRO-1 in play, replicated moments are just the normal dp layout.
+
 - UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
   ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
   ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
@@ -213,6 +226,19 @@ _UL113_FLEET_NAME_FRAGS = ("replica", "engine", "fleet")
 # chain passing through a "health" receiver)
 _UL113_HEALTH_PREFIXES = ("record_", "observe")
 
+# UL114: full-shape moment allocations inside an optimizer ``init()``
+_UL114_ALLOC_TAILS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+_UL114_ALLOC_SHAPE_TAILS = {"zeros", "ones", "full", "empty"}
+# UL114: receiver names that mark a ``.init(...)`` call as optimizer-
+# state creation
+_UL114_OPTIM_RECEIVERS = ("optim", "opt")
+# UL114: wrapping the creation in one of these IS the sanctioned
+# sharding-constraint context (jax.jit(init, out_shardings=...) never
+# produces a bare ``.init(...)`` Call node, so it is silent by shape)
+_UL114_SHARDED_WRAPPERS = {"with_sharding_constraint", "device_put",
+                           "make_array_from_callback",
+                           "make_array_from_single_device_arrays"}
+
 
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
@@ -245,6 +271,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._ul113_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
+        self._collect_zero1_plumbing()
 
     # -- setup ---------------------------------------------------------
 
@@ -1078,6 +1105,108 @@ class _ModuleLint(ast.NodeVisitor):
                     )
         self.generic_visit(node)
 
+    # -- UL114 ---------------------------------------------------------
+
+    def _collect_zero1_plumbing(self):
+        """Module precondition for UL114: the zero1 flag is *plumbed*
+        here — some Name/Attribute/argument mentions zero1.  Modules
+        that never see the flag (the optimizer zoo itself, plain
+        harnesses) are exempt: without ZeRO-1 in play a replicated
+        moment allocation is just the normal dp layout."""
+        self._zero1_plumbed = False
+        self._ul114_wrapped = set()
+        for node in ast.walk(self._tree):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.arg):
+                name = node.arg
+            elif isinstance(node, ast.keyword):
+                name = node.arg
+            if name and "zero1" in str(name).lower():
+                self._zero1_plumbed = True
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if (chain is not None
+                        and chain.split(".")[-1] in _UL114_SHARDED_WRAPPERS):
+                    for arg in node.args:
+                        self._ul114_wrapped.add(id(arg))
+
+    def _check_replicated_optim_init(self, node):
+        """UL114 pattern (a): a bare ``<optimizer>.init(params)`` call in
+        a zero1-plumbed module.  The sanctioned creation path routes
+        through ``jax.jit(opt.init, out_shardings=...)`` (whose ``init``
+        is an argument, not a call — silent by shape) or wraps the
+        result in a sharding constraint; anything else materializes a
+        full replicated fp32 moment tree on every replica before the
+        install re-shards it — the transient allocation ZeRO-1 exists
+        to avoid."""
+        if not self._zero1_plumbed or id(node) in self._ul114_wrapped:
+            return
+        chain = _attr_chain(node.func)
+        if chain is None or not chain.endswith(".init"):
+            return
+        parts = chain.split(".")
+        if len(parts) < 2:
+            return
+        recv = parts[-2].lower()
+        if not any(recv.startswith(r) for r in _UL114_OPTIM_RECEIVERS):
+            return
+        self.emit(
+            "UL114", "replicated-optim-state", "error", node,
+            f"bare '{chain}(...)' in a module that plumbs the zero1 "
+            f"flag — the optimizer state is created OUTSIDE a "
+            f"sharding-constraint context, so a full replicated fp32 "
+            f"moment tree materializes on every replica before any "
+            f"re-shard (the allocation --zero1 exists to avoid); "
+            f"create it through jax.jit(opt.init, out_shardings=...) "
+            f"(Trainer._init_opt_state) or wrap the result in "
+            f"with_sharding_constraint/device_put",
+        )
+
+    def _check_optim_init_allocations(self, fn):
+        """UL114 pattern (b): inside a function named ``init`` in a
+        zero1-plumbed module, a full-shape moment allocation
+        (``zeros_like(param)`` or ``zeros(param.shape, ...)``) outside
+        a sharding wrapper."""
+        if not self._zero1_plumbed:
+            return
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in self._ul114_wrapped:
+                continue
+            chain = _attr_chain(node.func)
+            if chain is None:
+                continue
+            tail = chain.split(".")[-1]
+            shaped = (
+                tail in _UL114_ALLOC_SHAPE_TAILS and node.args
+                and isinstance(node.args[0], ast.Attribute)
+                and node.args[0].attr == "shape"
+            )
+            if tail == "tree_map":
+                # tree_map(jnp.zeros_like, params) — the allocator rides
+                # as a bare function reference, not a call
+                for arg in node.args:
+                    ref = _attr_chain(arg)
+                    if (ref is not None
+                            and ref.split(".")[-1] in _UL114_ALLOC_TAILS):
+                        shaped = True
+                        chain = ref
+                        break
+            if tail in _UL114_ALLOC_TAILS or shaped:
+                self.emit(
+                    "UL114", "replicated-optim-state", "error", node,
+                    f"'{chain}' builds a full-shape moment leaf inside "
+                    f"'{fn.name}()' in a module that plumbs the zero1 "
+                    f"flag, outside any sharding-constraint context — "
+                    f"under --zero1 the moments must be *created* "
+                    f"sharded (jit the init with out_shardings, or "
+                    f"constrain each leaf) or every replica briefly "
+                    f"holds the full replicated tree",
+                )
+
     # -- UL110 ---------------------------------------------------------
 
     def _ul110_io_kind(self, call):
@@ -1178,6 +1307,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._check_where_nan(node)
         self._check_sync_in_step_loop(node)
         self._check_blocking_in_router_loop(node)
+        self._check_replicated_optim_init(node)
         self.generic_visit(node)
 
     def _visit_functions(self):
@@ -1189,6 +1319,8 @@ class _ModuleLint(ast.NodeVisitor):
                 if (self.dataset_file
                         and node.name in ("__getitem__", "__iter__")):
                     self._check_dataset_fetch_guard(node)
+                if node.name == "init":
+                    self._check_optim_init_allocations(node)
 
     def run(self):
         self.visit(self._tree)

@@ -23,4 +23,5 @@ from .utils import (  # noqa: F401
     reset_mesh,
     shard_batch,
     state_sharding,
+    zero1_sharding,
 )

@@ -205,7 +205,50 @@ def _path_names(path):
     return out
 
 
-def state_sharding(mesh, tree):
+def _leaf_spec(path, x, *, shard_axis, shard_size, tp_size):
+    """Per-dim mesh-axis assignment for one state leaf: Megatron tensor
+    rules by name, then the largest still-unsharded divisible dim over
+    ``shard_axis`` (the ZeRO dimension — ``fsdp`` for --fsdp-size,
+    ``data`` for --zero1's weight-update sharding)."""
+    dims = [None] * x.ndim
+    names = _path_names(path)
+    if tp_size > 1 and x.ndim:
+        tp = tensor_spec(names, x.shape)
+        if tp is not None:
+            for d, ax in enumerate(tp):
+                if ax is not None and x.shape[d] % tp_size == 0:
+                    dims[d] = ax
+    if shard_size > 1 and x.ndim >= 2:
+        # 1-D leaves (norm scales/biases and their optimizer moments)
+        # REPLICATE: ZeRO-sharding a [C] vector saves almost nothing,
+        # and its weight-aligned gradient reduction forces GSPMD to
+        # reshard the row-stat broadcasts of layer_norm's backward —
+        # the involuntary-full-remat warning (and UL202 byte cost)
+        # the fsdp2 compile used to carry.
+        if (
+            x.ndim == 2
+            and dims[0] == "tensor"
+            and len(names) >= 2
+            and names[-1] == "embedding"
+            and x.shape[0] % (tp_size * shard_size) == 0
+        ):
+            # vocab-parallel embedding under tensor x zero: stack BOTH
+            # axes on the vocab dim.  Putting the ZeRO axis on the
+            # feature dim makes the lookup emit feature-sharded
+            # activations that must reshard to batch-sharded — an SPMD
+            # involuntary full-remat; vocab-stacking keeps the
+            # masked-gather+psum form with the feature dim intact.
+            dims[0] = ("tensor", shard_axis)
+        else:
+            for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
+                if (dims[d] is None and x.shape[d] >= shard_size
+                        and x.shape[d] % shard_size == 0):
+                    dims[d] = shard_axis
+                    break
+    return dims
+
+
+def state_sharding(mesh, tree, *, zero1=False):
     """Leaf-wise NamedSharding pytree for a TrainState.
 
     Two composable rules: transformer weights shard Megatron-style over
@@ -213,49 +256,58 @@ def state_sharding(mesh, tree):
     still-unsharded divisible dim shards over ``fsdp`` (ZeRO).  Leaves
     that fit neither (step counters, scaler scalars, tiny biases)
     replicate.  The rules apply uniformly to params, optimizer moments,
-    and EMA because those subtrees mirror the param key paths."""
+    and EMA because those subtrees mirror the param key paths.
+
+    ``zero1``: ZeRO-1 weight-update sharding on a plain dp (or dp x tp)
+    mesh — leaves under the top-level ``opt_state`` key additionally
+    shard their largest divisible dim over the **data** axis, so each
+    replica stores (and updates) only its 1/N slice of the optimizer
+    moments while params stay replicated (arxiv 2004.13336; the grads
+    reduce-scatter and the update all-gather come from the trainer's
+    matching constraints, :func:`zero1_sharding`)."""
     jax = _jax()
     P = jax.sharding.PartitionSpec
     extent = dict(zip(mesh.axis_names, mesh.devices.shape))
     fsdp_size = extent.get("fsdp", 1)
     tp_size = extent.get("tensor", 1)
+    dp_size = extent.get("data", 1)
 
     def spec_for(path, x):
-        dims = [None] * x.ndim
-        names = _path_names(path)
-        if tp_size > 1 and x.ndim:
-            tp = tensor_spec(names, x.shape)
-            if tp is not None:
-                for d, ax in enumerate(tp):
-                    if ax is not None and x.shape[d] % tp_size == 0:
-                        dims[d] = ax
-        if fsdp_size > 1 and x.ndim >= 2:
-            # 1-D leaves (norm scales/biases and their optimizer moments)
-            # REPLICATE: fsdp-sharding a [C] vector saves almost nothing,
-            # and its weight-aligned gradient reduction forces GSPMD to
-            # reshard the row-stat broadcasts of layer_norm's backward —
-            # the involuntary-full-remat warning (and UL202 byte cost)
-            # the fsdp2 compile used to carry.
-            if (
-                x.ndim == 2
-                and dims[0] == "tensor"
-                and len(names) >= 2
-                and names[-1] == "embedding"
-                and x.shape[0] % (tp_size * fsdp_size) == 0
-            ):
-                # vocab-parallel embedding under tensor x fsdp: stack BOTH
-                # axes on the vocab dim.  Putting fsdp on the feature dim
-                # makes the lookup emit feature-sharded activations that
-                # must reshard to batch-sharded — an SPMD involuntary
-                # full-remat; vocab-stacking keeps the masked-gather+psum
-                # form with the feature dim intact.
-                dims[0] = ("tensor", "fsdp")
-            else:
-                for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
-                    if (dims[d] is None and x.shape[d] >= fsdp_size
-                            and x.shape[d] % fsdp_size == 0):
-                        dims[d] = "fsdp"
-                        break
+        in_opt = bool(path) and str(
+            getattr(path[0], "key", getattr(path[0], "name", path[0]))
+        ) == "opt_state"
+        if zero1 and dp_size > 1 and in_opt:
+            dims = _leaf_spec(path, x, shard_axis="data",
+                              shard_size=dp_size, tp_size=tp_size)
+        else:
+            dims = _leaf_spec(path, x, shard_axis="fsdp",
+                              shard_size=fsdp_size, tp_size=tp_size)
+        return jax.sharding.NamedSharding(mesh, P(*dims))
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def zero1_sharding(mesh, tree):
+    """ZeRO-1 data-axis sharding pytree for a *param-structured* tree
+    (the gradient / weight-update layout).
+
+    Same leaf rule the ``opt_state`` subtree gets under
+    ``state_sharding(..., zero1=True)``: tensor axes by name, then the
+    largest divisible dim over ``data``.  The trainer constrains the
+    accumulated grads to this layout so XLA emits a reduce-scatter over
+    the data axis (XLA:CPU emulates it as all-reduce+slice — group
+    structure, not op name, is the UL201 discriminator), runs the
+    optimizer update on the 1/N shard, and all-gathers the updated
+    slices back into the replicated params."""
+    jax = _jax()
+    P = jax.sharding.PartitionSpec
+    extent = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp_size = extent.get("data", 1)
+    tp_size = extent.get("tensor", 1)
+
+    def spec_for(path, x):
+        dims = _leaf_spec(path, x, shard_axis="data", shard_size=dp_size,
+                          tp_size=tp_size)
         return jax.sharding.NamedSharding(mesh, P(*dims))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)

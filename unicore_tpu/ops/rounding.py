@@ -32,7 +32,18 @@ def fp32_to_bf16_sr_reference(x, rng):
 
 
 def fp32_to_bf16_sr(x, rng):
-    if use_pallas():
+    # autotuner consult (op "optim_sr_cast", docs/kernel_autotuning.md):
+    # a cached "eager" verdict retires the kernel for this size bucket,
+    # a config dict forces it; None falls through to the use_pallas
+    # heuristic.  Decisions are trace-time and memoized, so the chosen
+    # random stream (threefry reference vs counter-hash kernel) is
+    # stable for the whole process — the chaos bit-exactness contract.
+    from unicore_tpu.ops import tuning
+
+    decision = tuning.sr_cast_decision(x.size, str(x.dtype))
+    if decision == "eager":
+        return fp32_to_bf16_sr_reference(x, rng)
+    if use_pallas() or isinstance(decision, dict):
         from .backend import kernel_probe_ok
         from .pallas import rounding as pl_impl
 

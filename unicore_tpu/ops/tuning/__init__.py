@@ -45,7 +45,7 @@ from unicore_tpu.ops.tuning.cache import (  # noqa: F401
 )
 from unicore_tpu.ops.tuning.candidates import (  # noqa: F401
     OPS, PRESETS, ce_workload, describe_config, flash_workload, ln_workload,
-    pow2_bucket, ragged_workload, sd_workload,
+    pow2_bucket, ragged_workload, sd_workload, sr_cast_workload,
 )
 
 logger = logging.getLogger(__name__)
@@ -311,6 +311,18 @@ def tuned_ce_chunk(rows, decision):
     if chunk < 1:
         return None
     return min(chunk, int(rows))
+
+
+def sr_cast_decision(n, dtype="float32", allow_tune=False):
+    """Stochastic-rounding fp32->bf16 cast (op ``optim_sr_cast``, used
+    by the bf16-moment optimizer store and the --bf16-sr master sync):
+    ``"eager"`` = the threefry jnp reference, ``{"impl": "pallas"}`` =
+    the VMEM-tiled kernel, None = the backend's use_pallas heuristic.
+    NOTE the two impls draw from different random streams (threefry vs
+    counter-hash) — fine for dispatch because decisions are trace-time
+    memoized per process, so one run never mixes streams mid-flight."""
+    return _decision("optim_sr_cast", sr_cast_workload(n, dtype),
+                     allow_tune=allow_tune)
 
 
 def ragged_paged_decision(q_shape, table_pages, page_size, dtype,

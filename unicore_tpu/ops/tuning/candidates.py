@@ -499,6 +499,49 @@ def _ce_shrink(wl):
 
 
 # ---------------------------------------------------------------------------
+# optim_sr_cast — stochastic-rounding fp32 -> bf16 (optimizer moments)
+# ---------------------------------------------------------------------------
+
+
+def sr_cast_workload(n, dtype="float32"):
+    """``n``: flat element count of the cast leaf (the moment sizes the
+    bf16-moment optimizer store re-quantizes every update)."""
+    return {"op": "optim_sr_cast", "n": int(n), "dtype": str(dtype)}
+
+
+def _sr_cast_bucket(wl):
+    # one entry covers a pow2 family of leaf sizes; the kernel's row
+    # block is a pure function of n (pick_layout), so the config space
+    # is impl choice only
+    return ("optim_sr_cast", wl["dtype"], pow2_bucket(wl["n"]))
+
+
+def _sr_cast_candidates(wl):
+    # eager (threefry jnp reference) vs the Pallas VMEM-tiled kernel:
+    # both are ONE bit-twiddling pass, so the only question the timing
+    # answers is whether the kernel's fixed costs amortize at this size
+    return ["eager", {"impl": "pallas"}]
+
+
+def _sr_cast_runner(wl, config):
+    import jax
+
+    from unicore_tpu.ops.rounding import fp32_to_bf16_sr_reference
+
+    x = _zeros((wl["n"],), wl["dtype"])
+    rng = jax.random.PRNGKey(0)
+    if config == "eager":
+        return _aot(fp32_to_bf16_sr_reference, x, rng)
+    from unicore_tpu.ops.pallas import rounding as pl_impl
+
+    return _aot(pl_impl.fp32_to_bf16_sr, x, rng)
+
+
+def _sr_cast_shrink(wl):
+    return dict(wl, n=min(wl["n"], 4096))
+
+
+# ---------------------------------------------------------------------------
 # layer_norm
 # ---------------------------------------------------------------------------
 
@@ -570,6 +613,10 @@ OPS = {
         "fused_cross_entropy", _ce_bucket, _ce_candidates, _ce_runner,
         _ce_shrink,
     ),
+    "optim_sr_cast": OpSpec(
+        "optim_sr_cast", _sr_cast_bucket, _sr_cast_candidates,
+        _sr_cast_runner, _sr_cast_shrink,
+    ),
 }
 
 
@@ -606,4 +653,8 @@ PRESETS = {
     # MLM head at the batch-64 bench shape: 8192 static slots
     # (32768 tokens x 0.25 capacity), tied-embedding projection
     "fused_ce_bert": ce_workload(8192, 768, 30528, "bfloat16"),
+    # bf16-moment SR re-quantization at the BERT-base attention-kernel
+    # leaf size (768x768) — the shape --optim-bf16-moments casts ~48
+    # times per update
+    "optim_sr_cast_moments": sr_cast_workload(768 * 768),
 }

@@ -8,18 +8,23 @@ TPU-native form: a functional update traced into the jitted train step.
 The "fused" property comes for free — XLA fuses the whole elementwise update
 chain across the parameter tree into a handful of kernels, which is exactly
 what the multi-tensor CUDA kernel hand-built.  Optimizer state (m, v) is
-fp32 regardless of param dtype, matching ``adam_kernel.cu:79-96``'s mixed
-template.
+fp32 by default, matching ``adam_kernel.cu:79-96``'s mixed template.
 
-Matching ``--fp16-adam-stats`` is intentionally NOT provided: bf16 state
-halves memory but measurably hurts convergence; the reference also keeps
-fp32 state (``fp16_optimizer.py:34-46``).
+``--optim-bf16-moments`` stores exp_avg/exp_avg_sq in bf16 at half the
+bytes: the update math still runs in fp32 (moments upcast on entry) and the
+new moments re-quantize through the stochastic-rounding ``fp32_to_bf16_sr``
+op (the reference's ``unicore_fused_rounding`` extension,
+``csrc/rounding/fp32_to_bf16.cu``) so the EMA stays an unbiased
+accumulator — plain round-to-nearest would silently drop every sub-ulp
+contribution and bend the loss trajectory (validated empirically by
+tests/test_zero1.py's trajectory comparison).
 """
 
 import jax
 import jax.numpy as jnp
 
 from . import register_optimizer
+from .fp16_optimizer import cast_moments
 from .unicore_optimizer import UnicoreOptimizer
 
 
@@ -37,6 +42,13 @@ class UnicoreAdam(UnicoreOptimizer):
         self.beta1, self.beta2 = float(betas[0]), float(betas[1])
         self.eps = float(getattr(args, "adam_eps", 1e-8))
         self.weight_decay = float(getattr(args, "weight_decay", 0.0))
+        self.moments_dtype = (
+            jnp.bfloat16 if getattr(args, "optim_bf16_moments", False)
+            else jnp.float32
+        )
+        self.moments_rounding = str(
+            getattr(args, "optim_bf16_moments_rounding", None) or "sr"
+        )
 
     @classmethod
     def add_args(cls, parser):
@@ -47,41 +59,70 @@ class UnicoreAdam(UnicoreOptimizer):
         parser.add_argument('--weight-decay', '--wd', default=0.0, type=float,
                             metavar='WD', help='weight decay')
 
+    @property
+    def wants_update_rng(self):
+        return (self.moments_dtype != jnp.float32
+                and self.moments_rounding == "sr")
+
     def init(self, params):
-        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        zeros = lambda p: jnp.zeros(p.shape, dtype=self.moments_dtype)
         return {
             "step": jnp.zeros((), dtype=jnp.int32),
             "exp_avg": jax.tree_util.tree_map(zeros, params),
             "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
         }
 
-    def update(self, grads, state, params, *, lr):
+    def update(self, grads, state, params, *, lr, rng=None):
         b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
         step = state["step"] + 1
         stepf = step.astype(jnp.float32)
         bc1 = 1.0 - b1 ** stepf
         bc2 = 1.0 - b2 ** stepf
         step_size = lr * jnp.sqrt(bc2) / bc1
+        store = self.moments_dtype
+        rounding = self.moments_rounding
 
-        def upd(g, m, v, p):
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        m_leaves = jax.tree_util.tree_leaves(state["exp_avg"])
+        v_leaves = jax.tree_util.tree_leaves(state["exp_avg_sq"])
+        p_leaves = jax.tree_util.tree_leaves(params)
+
+        updates, new_m, new_v = [], [], []
+        for i, (g, m, v, p) in enumerate(
+            zip(g_leaves, m_leaves, v_leaves, p_leaves)
+        ):
             g = g.astype(jnp.float32)
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * v + (1.0 - b2) * (g * g)
-            denom = jnp.sqrt(v) + eps * jnp.sqrt(bc2)
+            # math in fp32 regardless of the storage dtype
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+            v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * (g * g)
+            denom = jnp.sqrt(v32) + eps * jnp.sqrt(bc2)
             # decoupled weight decay (adam_kernel.cu:36-37: p *= 1 - lr*wd)
-            delta = -step_size * m / denom - lr * wd * p.astype(jnp.float32)
-            return delta, m, v
-
-        flat = jax.tree_util.tree_map(
-            upd, grads, state["exp_avg"], state["exp_avg_sq"], params
-        )
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat,
-                                         is_leaf=lambda t: isinstance(t, tuple))
-        new_m = jax.tree_util.tree_map(lambda t: t[1], flat,
-                                       is_leaf=lambda t: isinstance(t, tuple))
-        new_v = jax.tree_util.tree_map(lambda t: t[2], flat,
-                                       is_leaf=lambda t: isinstance(t, tuple))
-        return updates, {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v}
+            delta = -step_size * m32 / denom - lr * wd * p.astype(jnp.float32)
+            if store != jnp.float32:
+                # distinct key per (leaf, moment): the two EMAs of one
+                # leaf must not share noise, nor two leaves of one step
+                leaf_key = None if rng is None else jax.random.fold_in(rng, i)
+                m32 = cast_moments(
+                    m32, store,
+                    rng=None if leaf_key is None
+                    else jax.random.fold_in(leaf_key, 0),
+                    rounding=rounding,
+                )
+                v32 = cast_moments(
+                    v32, store,
+                    rng=None if leaf_key is None
+                    else jax.random.fold_in(leaf_key, 1),
+                    rounding=rounding,
+                )
+            updates.append(delta)
+            new_m.append(m32)
+            new_v.append(v32)
+        unflatten = jax.tree_util.tree_unflatten
+        return unflatten(treedef, updates), {
+            "step": step,
+            "exp_avg": unflatten(treedef, new_m),
+            "exp_avg_sq": unflatten(treedef, new_v),
+        }
 
     @property
     def supports_flat_params(self):

@@ -72,6 +72,39 @@ def sync_master_to_model(master, model_dtype, sr_rng=None):
     return jax.tree_util.tree_map(lambda p: p.astype(model_dtype), master)
 
 
+def cast_moments(x, dtype, rng=None, rounding="sr"):
+    """Cast one fp32 optimizer-moment leaf to its storage ``dtype``.
+
+    The bf16 path defaults to stochastic rounding through the same
+    ``fp32_to_bf16_sr`` op the master->model sync uses (the reference's
+    ``unicore_fused_rounding`` CUDA extension): the quantization error
+    is zero-mean, so the moment EMAs stay unbiased accumulators —
+    deterministic round-to-nearest (``rounding="nearest"``) biases every
+    sub-ulp contribution toward zero and visibly bends the loss
+    trajectory (tests/test_zero1.py makes the comparison empirical).
+    No gradient flows here: the optimizer update is never
+    differentiated, so this calls the op directly rather than the
+    straight-through ``custom_vjp`` wrapper."""
+    if dtype == jnp.float32 or x.dtype == dtype:
+        return x
+    if rounding == "sr":
+        if dtype != jnp.bfloat16:
+            # falling through to astype would silently hand back the
+            # biased deterministic rounding the caller asked to avoid
+            raise NotImplementedError(
+                f"stochastic rounding is implemented for bf16 moment "
+                f"stores only (got {jnp.dtype(dtype).name}); use "
+                f'rounding="nearest" explicitly if bias is acceptable'
+            )
+        if rng is None:
+            raise ValueError(
+                "stochastically-rounded moment casts need an rng key "
+                "(the trainer passes one when wants_update_rng is True)"
+            )
+        return ops.fp32_to_bf16_sr(x, rng)
+    return x.astype(dtype)
+
+
 def grads_finite(grads):
     """Global all-finite check over a grad pytree (the analogue of the
     reference's inf/nan grad-norm overflow test, fp16_optimizer.py:189-206)."""

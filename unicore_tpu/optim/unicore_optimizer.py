@@ -51,10 +51,23 @@ class UnicoreOptimizer:
 
     def update(self, grads, state, params, *, lr):
         """One optimizer step. Returns ``(updates, new_state)`` where
-        ``updates`` are deltas to add to the params (optax convention)."""
+        ``updates`` are deltas to add to the params (optax convention).
+
+        Optimizers whose :attr:`wants_update_rng` is True take an extra
+        ``rng=`` keyword (a per-step PRNG key the trainer folds from its
+        dispatch stream) for stochastically-rounded state casts."""
         raise NotImplementedError
 
     # -- capability flags (reference unicore_optimizer.py:163-189) ------------
+
+    @property
+    def wants_update_rng(self):
+        """Whether :meth:`update` takes an ``rng=`` key (stochastic
+        rounding of low-precision optimizer state draws from it).  The
+        trainer only passes the keyword when this is True, so existing
+        optimizers keep their exact signature (and the default-path
+        traced program stays byte-identical)."""
+        return False
 
     @property
     def supports_flat_params(self):

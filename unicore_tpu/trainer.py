@@ -44,6 +44,7 @@ from unicore_tpu.distributed import (
     replicated,
     shard_batch,
     state_sharding,
+    zero1_sharding,
 )
 from unicore_tpu.optim import build_optimizer
 from unicore_tpu.optim.dynamic_loss_scaler import scaler_init, scaler_update
@@ -179,6 +180,28 @@ class Trainer:
         self.is_data_parallel_master = self.data_parallel_rank == 0
         self._mesh_shape = dict(
             zip(self.mesh.axis_names, self.mesh.devices.shape)
+        )
+
+        # ZeRO-1 weight-update sharding (--zero1, arxiv 2004.13336):
+        # optimizer moments shard over the DATA axis, grads
+        # reduce-scatter, each replica updates its 1/N param slice, and
+        # the updated slices all-gather back into the replicated params.
+        # On a 1-device data axis the specs degenerate to replicated —
+        # one recipe spans laptop-CPU tests and full-pod runs.
+        self.zero1 = bool(getattr(args, "zero1", False))
+        if self.zero1 and self._mesh_shape.get("fsdp", 1) > 1:
+            raise NotImplementedError(
+                "--zero1 with --fsdp-size > 1 is redundant: the fsdp "
+                "axis already shards the optimizer state (ZeRO); pick "
+                "one scheme"
+            )
+        if self.zero1 and self._mesh_shape.get("seq", 1) > 1:
+            raise NotImplementedError(
+                "--zero1 with --seq-parallel-size > 1 is not supported "
+                "yet; the certified meshes are dp and dp x tp"
+            )
+        self._zero1_active = (
+            self.zero1 and self._mesh_shape.get("data", 1) > 1
         )
 
         # activate sequence parallelism for this run's mesh: attention
@@ -405,7 +428,7 @@ class Trainer:
         params = self.model.init_params(rng, utils.tree_map_arrays(jnp.asarray, sample))
         params = make_master_params(params)  # fp32 source of truth
         self._build_optimizer()
-        opt_state = self.optimizer.init(params)
+        opt_state = self._init_opt_state(params)
         state = {
             "step": jnp.zeros((), dtype=jnp.int32),
             "params": params,
@@ -433,17 +456,46 @@ class Trainer:
             )
         )
 
+    def _init_opt_state(self, params):
+        """Create the optimizer state — ALWAYS through a jitted call
+        whose ``out_shardings`` pin the moment layout.  Under ``--zero1``
+        the moments are *created* data-axis-sharded, so a replicated
+        fp32 copy never materializes on any device (a transient
+        full-size allocation at init is exactly the OOM the sharding
+        exists to avoid; UL114's replicated-optim-state lint guards the
+        call-site pattern).  Without zero1 the out_shardings are the
+        replicated/fsdp specs the state would receive anyway — the
+        values (zeros + a step scalar) are bit-identical to an eager
+        init."""
+        abstract = jax.eval_shape(self.optimizer.init, params)
+        shardings = state_sharding(
+            self.mesh, {"opt_state": abstract}, zero1=self._zero1_active
+        )["opt_state"]
+        return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
+
     def _install_state(self, state):
         """Shard + device-put a host state tree as the live TrainState.
 
         pure DP: every leaf replicates; --fsdp-size > 1: master params,
         optimizer state, and EMA shard leaf-wise over the fsdp axis (ZeRO);
+        --zero1: optimizer state shards leaf-wise over the DATA axis
+        (ZeRO-1 weight-update sharding) while params stay replicated;
         --tensor-parallel-size > 1: transformer weights shard by name;
         scalars (step, scaler) stay replicated.  ShardedLeaf markers (from
         a sharded checkpoint) materialize from this process's shard pieces
         without ever assembling the full array on any host."""
         state = _map_host_arrays(jnp.asarray, state)
-        self._state_shardings = state_sharding(self.mesh, state)
+        self._state_shardings = state_sharding(
+            self.mesh, state, zero1=self._zero1_active
+        )
+        # ZeRO-1 update layout: the step constrains the accumulated
+        # grads to this param-structured data-sharded spec (emitting the
+        # reduce-scatter) so the optimizer update runs on each replica's
+        # 1/N shard before the all-gather back to replicated params
+        self._zero1_shardings = (
+            zero1_sharding(self.mesh, state["params"])
+            if self._zero1_active else None
+        )
         # ZeRO compute layout: the step casts master -> compute dtype and
         # constrains the result to the fsdp-stripped shardings (see
         # distributed.utils.strip_axis)
@@ -453,6 +505,14 @@ class Trainer:
             self._compute_param_shardings = strip_axis(
                 self._state_shardings["params"]
             )
+        elif self._zero1_active:
+            # pin the compute-dtype cast to the stored (replicated /
+            # tensor-sharded) param layout: without the constraint,
+            # sharding propagation leaks the data-sharded gradient
+            # layout backwards through the cast's adjoint into the
+            # forward activations — the same involuntary-full-remat
+            # GSPMD warning the fsdp2 compile used to carry
+            self._compute_param_shardings = self._state_shardings["params"]
         else:
             self._compute_param_shardings = None
 
@@ -634,6 +694,17 @@ class Trainer:
         if self.optimizer is not None:
             return
         self.optimizer = build_optimizer(self.args)
+        if (getattr(self.args, "optim_bf16_moments", False)
+                and getattr(self.optimizer, "moments_dtype", jnp.float32)
+                == jnp.float32):
+            # a flag the selected optimizer ignores must not pass as a
+            # silent no-op: the user believes optimizer memory halved
+            raise NotImplementedError(
+                f"--optim-bf16-moments is implemented by the adam "
+                f"optimizer only; --optimizer "
+                f"{getattr(self.args, 'optimizer', '?')} keeps "
+                f"full-precision state"
+            )
         self.lr_scheduler = build_lr_scheduler(
             self.args, self.optimizer, self.total_train_steps
         )
@@ -695,6 +766,13 @@ class Trainer:
         min_loss_scale = float(getattr(self.args, "min_loss_scale", 1e-4))
         optimizer = self.optimizer
         state_shardings = self._state_shardings
+        # ZeRO-1: grads (and the in-scan accumulator) constrain to the
+        # data-sharded update layout instead of the replicated param
+        # specs — None leaves the classic dp/fsdp program untouched
+        zero1_shardings = self._zero1_shardings
+        grad_shardings = (zero1_shardings if zero1_shardings is not None
+                          else state_shardings["params"])
+        wants_opt_rng = bool(optimizer.wants_update_rng)
         guard_cfg = self._guard_cfg
         chaos_inject = self._chaos_inject
         # fast path (reference trainer.py:973-1055): summable logging
@@ -777,9 +855,13 @@ class Trainer:
                 # without this, sharding propagation is free to invent a
                 # feature-dim fsdp layout for the grad chain, which drags
                 # the layer_norm backward's [B,T,C] row-stat broadcasts
-                # into an involuntary full remat (the fsdp2 UL202 cost)
+                # into an involuntary full remat (the fsdp2 UL202 cost).
+                # Under --zero1 the accumulator is instead pinned to the
+                # data-sharded update layout: each micro-batch's partial
+                # grads reduce-scatter into a 1/N-sized carry (grad
+                # memory /N and all-reduce bytes halved per micro)
                 grads_acc = jax.lax.with_sharding_constraint(
-                    grads_acc, state_shardings["params"]
+                    grads_acc, grad_shardings
                 )
                 if sum_logs:
                     logs_acc = jax.tree_util.tree_map(
@@ -835,12 +917,11 @@ class Trainer:
             # the guard's step-loss statistic: mean loss per sample unit,
             # unscaled — comparable across steps regardless of loss scale
             loss_mean = loss_sum / denom
-            # ZeRO: constrain grads to the fsdp sharding so XLA emits a
-            # reduce-scatter (not all-reduce) and the optimizer update runs
-            # on each device's param shard only
-            grads = jax.lax.with_sharding_constraint(
-                grads, state_shardings["params"]
-            )
+            # ZeRO: constrain grads to the sharded update layout (fsdp
+            # axis, or the data axis under --zero1) so XLA emits a
+            # reduce-scatter (not all-reduce) and the optimizer update
+            # runs on each device's param shard only
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
             grad_norm = utils.global_norm(grads)
             if clip_norm > 0:
@@ -861,8 +942,14 @@ class Trainer:
                 state["guard"], guard_loss, overflow, guard_cfg
             )
 
+            opt_kw = {}
+            if wants_opt_rng:
+                # stochastically-rounded moment casts draw from the step
+                # rng under a domain tag disjoint from the micro-batch
+                # fold_in(rng, idx) chain and the 0x5F1C bf16-sr stream
+                opt_kw["rng"] = jax.random.fold_in(rng, 0x0B16)
             updates, new_opt_state = optimizer.update(
-                grads, state["opt_state"], state["params"], lr=lr
+                grads, state["opt_state"], state["params"], lr=lr, **opt_kw
             )
             new_params = jax.tree_util.tree_map(
                 lambda p, u: p + u, state["params"], updates
