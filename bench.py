@@ -201,13 +201,20 @@ def _peak_flops():
 
 
 def _train_flops_per_step(cfg):
-    """Model FLOPs per optimizer step (fwd + ~2x bwd), matmuls only."""
+    """Model FLOPs per optimizer step (fwd + ~2x bwd), matmuls only.
+    Dims come from ``cfg`` when present (the shrunk CPU-tier trainer)
+    and fall back to the big-config globals for the primary run."""
     B, T = cfg["batch"], cfg["seq"]
-    per_layer = 4 * DIM * DIM + 2 * DIM * FFN  # qkv+out, fc1+fc2 (MACs/token)
-    enc = B * T * per_layer * LAYERS
-    attn = LAYERS * B * HEADS * T * T * (DIM // HEADS) * 2  # QK^T + PV
+    dim = cfg.get("dim", DIM)
+    ffn = cfg.get("ffn", FFN)
+    heads = cfg.get("heads", HEADS)
+    layers = cfg.get("layers", LAYERS)
+    vocab = cfg.get("vocab", VOCAB)
+    per_layer = 4 * dim * dim + 2 * dim * ffn  # qkv+out, fc1+fc2 (MACs/token)
+    enc = B * T * per_layer * layers
+    attn = layers * B * heads * T * T * (dim // heads) * 2  # QK^T + PV
     k_slots = min(-(-int(round(B * T * 0.25)) // 128) * 128, B * T)
-    head = k_slots * (DIM * DIM + DIM * VOCAB)
+    head = k_slots * (dim * dim + dim * vocab)
     return 3.0 * 2.0 * (enc + attn + head)  # 2 FLOPs/MAC, 3x for training
 
 
@@ -1005,6 +1012,25 @@ def _zero1_child_main():
             return (time.perf_counter() - t0) / cfg["steps"]
 
         sides[key] = measure
+        if key == "zero1":
+            # Pass-4 schedule stats on the SAME compiled step the ratio
+            # measures: XLA:CPU schedules collectives synchronously, so
+            # overlap_ratio here reads 0.0 / exposed == total — the
+            # bench-side statement of what zero1_step_overhead_ratio
+            # costs, and the number ROADMAP item 5 moves on real HW.
+            from unicore_tpu.analysis import schedule_audit
+
+            art = trainer.trace_train_step([batch])
+            _, stats = schedule_audit.audit_schedule_text(
+                art["lowered"].compile().as_text(), context="bench/zero1"
+            )
+            out["zero1_overlap_ratio"] = (
+                0.0 if stats["overlap_ratio"] is None
+                else stats["overlap_ratio"]
+            )
+            out["zero1_exposed_collective_bytes"] = stats[
+                "exposed_collective_bytes"]
+            out["zero1_collective_bytes"] = stats["total_collective_bytes"]
     # paired alternating windows (the _pipeline_micro drift-cancelling
     # protocol): each ratio's two sides run within one ~2-window span
     ratios = []
@@ -1059,6 +1085,10 @@ def _zero1_micros(out):
     out["zero1_optim_bytes_ratio"] = child["zero1_optim_bytes_ratio"]
     out["zero1_step_overhead_ratio"] = child["zero1_step_overhead_ratio"]
     out["zero1_mesh_devices"] = child["devices"]
+    for k in ("zero1_overlap_ratio", "zero1_exposed_collective_bytes",
+              "zero1_collective_bytes"):
+        if k in child:
+            out[k] = child[k]
 
     # SR cast A/B in THIS process (no mesh dependency): reference jnp
     # composition vs the dispatched op (autotune verdict / use_pallas
@@ -1121,6 +1151,58 @@ def _fused_ce_micro(out):
     ratio, spread = _interleaved_ratio(sides["on"][0], sides["off"][0])
     _metrics.reset()
     return round(ratio, 3), spread
+
+
+def _train_mfu_micro(out):
+    """Train-step MFU on the shrunk 2x64 trainer against a MEASURED
+    matmul roofline: ``_peak_flops()`` has no entry for the CPU tier,
+    so the denominator is the best achieved f32 1024^3 matmul rate on
+    this container (``_timed``) — the utilization number is then
+    comparable round-over-round on the same image even though the
+    absolute FLOP/s is tiny.  This is the before-number for the
+    overlap-driven MFU item (ROADMAP 5): Pass 4 records the same
+    step's overlap_ratio, and future scheduling work should move both
+    together."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unicore_tpu import metrics as _metrics
+    from unicore_tpu.distributed import utils as dist_utils
+
+    # measured roofline first — it needs no trainer state
+    n = 1024
+    a = jnp.zeros((n, n), jnp.float32)
+    t_mm = _timed(jax.jit(lambda x, y: x @ y), a, a)
+    peak = 2.0 * n ** 3 / t_mm
+
+    cfg = dict(batch=16, steps=6, warmup=2, seq=256,
+               layers=2, dim=64, ffn=128, heads=2)
+    dist_utils.reset_mesh()
+    trainer, d, mask_idx = _build_trainer(cfg)
+    rng = np.random.RandomState(0)
+    batch = _make_batch(rng, d, mask_idx, cfg["batch"], cfg["seq"])
+    windows = []
+    with _metrics.aggregate("train"):
+        for _ in range(cfg["warmup"]):
+            trainer.train_step([batch])
+        trainer.flush_stats()
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(cfg["steps"]):
+                trainer.train_step([batch])
+            trainer.flush_stats()
+            windows.append((time.perf_counter() - t0) / cfg["steps"])
+    _metrics.reset()
+    windows.sort()
+    step_s = windows[len(windows) // 2]
+    out["train_step_time_ms"] = round(step_s * 1e3, 2)
+    out["train_matmul_peak_gflops"] = round(peak / 1e9, 1)
+    out["train_model_gflops_per_step"] = round(
+        _train_flops_per_step(cfg) / 1e9, 2
+    )
+    spread = (windows[-1] - windows[0]) / step_s * 100.0
+    return round(_train_flops_per_step(cfg) / step_s / peak, 4), spread
 
 
 def _microbench(out):
@@ -1530,6 +1612,7 @@ def _cpu_tier_main():
          lambda: _serve_ragged_micros(micro)),
         ("serve_shed_rate", lambda: _serve_robustness(micro)),
         ("fused_ce_speedup", lambda: _fused_ce_micro(micro)),
+        ("train_mfu", lambda: _train_mfu_micro(micro)),
         ("step_boundary_host_ms", lambda: _host_overlap_micros(micro)),
         ("input_stall_ms", lambda: _input_stall_micro(micro)),
         ("pipeline_depth_speedup", lambda: _pipeline_micro(micro)),

@@ -1858,3 +1858,493 @@ def test_ul114_repo_sweep_clean():
         if f.rule == "UL114"
     ]
     assert found == [], "\n".join(f.render() for f in found)
+
+
+# ---------------------------------------------------------------------
+# Pass 4: compiled-schedule audit (UL301/UL302/UL303) —
+# unicore_tpu/analysis/schedule_audit.py
+# ---------------------------------------------------------------------
+
+def _sched_module(body):
+    """Synthetic scheduled-HLO module text in the exact dump format
+    ``compiled.as_text()`` emits (two-space indent, ``%name = shape
+    op(...)``) — the fixtures feed the SAME parser path a real
+    compile's text does."""
+    return (
+        "HloModule fixture, is_scheduled=true\n\n"
+        "ENTRY %main.1 (p0: f32[64,64]) -> f32[64,64] {\n"
+        "  %p0 = f32[64,64]{1,0} parameter(0)\n"
+        + body
+        + "  ROOT %out.1 = f32[64,64]{1,0} add(f32[64,64]{1,0} %p0, "
+          "f32[64,64]{1,0} %p0)\n}\n"
+    )
+
+
+_AG_START = (
+    "  %ag-start = (f32[64,64]{1,0}, f32[128,64]{1,0}) "
+    "all-gather-start(f32[64,64]{1,0} %p0), replica_groups={{0,1}}, "
+    "dimensions={0}\n"
+)
+_AG_DONE = (
+    "  %ag-done = f32[128,64]{1,0} all-gather-done((f32[64,64]{1,0}, "
+    "f32[128,64]{1,0}) %ag-start)\n"
+)
+# 2 * 64*64 result elems * 128 contraction = 1048576 flops
+_BIG_DOT = (
+    "  %dot.1 = f32[64,64]{1,0} dot(f32[64,128]{1,0} %p0, "
+    "f32[128,64]{1,0} %p0), lhs_contracting_dims={1}, "
+    "rhs_contracting_dims={0}\n"
+)
+
+
+def test_schedule_parser_structure_and_pairs():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    comps = sa.parse_schedule(_sched_module(_AG_START + _BIG_DOT
+                                            + _AG_DONE))
+    assert len(comps) == 1 and comps[0].is_entry
+    ops = [i.op for i in comps[0].instrs]
+    assert ops == ["parameter", "all-gather-start", "dot",
+                   "all-gather-done", "add"]
+    pairs, unmatched, orphans, crossed = sa.match_async_pairs(comps[0])
+    assert len(pairs) == 1 and not (unmatched or orphans or crossed)
+    start, done = pairs[0]
+    assert start.kind == "all-gather" and start.is_float
+    # -start tuple result counts the LARGEST component only (the
+    # operand alias must not double-count the transfer)
+    assert start.bytes == 128 * 64 * 4
+
+
+def test_schedule_parser_interleaved_pairs_match_by_operand():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    body = (
+        _AG_START
+        + "  %ar-start = f32[256]{0} all-reduce-start(f32[256]{0} %p0), "
+          "replica_groups={{0,1}}, to_apply=%add\n"
+        + _BIG_DOT
+        + _AG_DONE
+        + "  %ar-done = f32[256]{0} all-reduce-done(f32[256]{0} "
+          "%ar-start)\n"
+    )
+    comps = sa.parse_schedule(_sched_module(body))
+    pairs, unmatched, orphans, crossed = sa.match_async_pairs(comps[0])
+    # healthy interleaving (s1 s2 d1 d2) pairs by OPERAND, not nesting
+    assert {(s.name, d.name) for s, d in pairs} == {
+        ("ag-start", "ag-done"), ("ar-start", "ar-done")}
+    assert not (unmatched or orphans or crossed)
+    found, stats = sa.audit_schedule_text(
+        _sched_module(body), context="fix")
+    assert [f for f in found if f.rule == "UL303"] == []
+    assert stats["async_pairs"] == 2
+
+
+def test_schedule_window_attribution_counts_dot_flops():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    _, stats = sa.audit_schedule_text(
+        _sched_module(_AG_START + _BIG_DOT + _AG_DONE), context="fix")
+    assert stats["window_flops"] == 2 * 64 * 64 * 128
+    assert stats["async_collectives"] == 1
+    assert stats["overlap_ratio"] == 1.0
+    assert stats["exposed_collective_bytes"] == 0
+
+
+def test_ul303_unmatched_start_and_orphan_done():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, _ = sa.audit_schedule_text(
+        _sched_module(_AG_START + _BIG_DOT), context="fix")
+    msgs = [f for f in found if f.rule == "UL303"]
+    assert msgs and "no matching -done" in msgs[0].message
+
+    found, _ = sa.audit_schedule_text(
+        _sched_module(_BIG_DOT + _AG_DONE), context="fix")
+    msgs = [f for f in found if f.rule == "UL303"]
+    assert msgs and "no known -start" in msgs[0].message
+
+
+def test_ul303_crossed_pair_is_corruption():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, _ = sa.audit_schedule_text(
+        _sched_module(_AG_DONE + _BIG_DOT + _AG_START), context="fix")
+    msgs = [f.message for f in found if f.rule == "UL303"]
+    assert any("BEFORE its start" in m for m in msgs), found
+
+
+def test_ul303_zero_width_window_warns():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, stats = sa.audit_schedule_text(
+        _sched_module(_AG_START + _AG_DONE + _BIG_DOT), context="fix")
+    assert stats["zero_width_pairs"] == 1
+    assert any(f.rule == "UL303" and f.severity == "warning"
+               for f in found)
+
+
+def test_ul301_fires_on_serialized_schedule():
+    """The deliberately serialized fixture: an empty start/done window
+    with overlappable compute scheduled after it must fire UL301."""
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, stats = sa.audit_schedule_text(
+        _sched_module(_AG_START + _AG_DONE + _BIG_DOT), context="fix")
+    fired = [f for f in found if f.rule == "UL301"]
+    assert fired and "exposed" in fired[0].message
+    assert stats["overlap_ratio"] == 0.0
+    assert stats["exposed_collective_bytes"] == 128 * 64 * 4
+
+
+def test_ul301_silent_when_overlapped():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, _ = sa.audit_schedule_text(
+        _sched_module(_AG_START + _BIG_DOT + _AG_DONE), context="fix")
+    assert [f for f in found if f.rule == "UL301"] == []
+
+
+def test_ul301_whitelists_tail_positioned_collective():
+    """Nothing above the compute floor after the done: there is no
+    compute left to hide the collective behind — silent."""
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    found, _ = sa.audit_schedule_text(
+        _sched_module(_BIG_DOT + _AG_START + _AG_DONE), context="fix")
+    assert [f for f in found if f.rule == "UL301"] == []
+
+
+def test_ul301_whitelists_op_name_patterns():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    wl_start = _AG_START.replace(
+        "dimensions={0}\n",
+        'dimensions={0}, metadata={op_name="zero1_param_gather"}\n')
+    found, _ = sa.audit_schedule_text(
+        _sched_module(wl_start + _AG_DONE + _BIG_DOT), context="fix")
+    assert [f for f in found if f.rule == "UL301"] == []
+
+
+def test_ul301_ignores_int_collectives():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    body = (
+        "  %rng-start = (u32[64]{0}, u32[128]{0}) all-gather-start("
+        "u32[64]{0} %p0), replica_groups={{0,1}}, dimensions={0}\n"
+        "  %rng-done = u32[128]{0} all-gather-done((u32[64]{0}, "
+        "u32[128]{0}) %rng-start)\n" + _BIG_DOT
+    )
+    found, _ = sa.audit_schedule_text(_sched_module(body), context="fix")
+    assert [f for f in found if f.rule == "UL301"] == []
+
+
+def test_sync_collectives_count_as_exposed():
+    """XLA:CPU lowers every collective synchronously — no async pairs;
+    every byte exposed by construction (the documented CPU caveat)."""
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    body = (
+        "  %ar.1 = f32[256]{0} all-reduce(f32[256]{0} %p0), "
+        "replica_groups={{0,1}}, to_apply=%add\n" + _BIG_DOT
+    )
+    found, stats = sa.audit_schedule_text(
+        _sched_module(body), context="fix")
+    assert found == []
+    assert stats["sync_collectives"] == 1
+    assert stats["async_pairs"] == 0
+    assert stats["overlap_ratio"] == 0.0
+    assert stats["exposed_collective_bytes"] == 256 * 4
+    assert stats["exposed_collective_bytes"] == \
+        stats["total_collective_bytes"]
+
+
+def test_ul302_budget_semantics(tmp_path):
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    stats = {"total_collective_bytes": 1000,
+             "overlapped_collective_bytes": 600,
+             "exposed_collective_bytes": 400, "overlap_ratio": 0.6}
+    # no committed entry -> warning nudge toward --update-budgets
+    got = sa.audit_overlap_budget("bert/dp", stats, None)
+    assert [f.severity for f in got] == ["warning"]
+    # matching entry -> clean
+    entry = {"exposed_collective_bytes": 400, "overlap_ratio": 0.6}
+    assert sa.audit_overlap_budget("bert/dp", stats, entry) == []
+    # exposed bytes regressed >5% -> error
+    got = sa.audit_overlap_budget(
+        "bert/dp", stats, {"exposed_collective_bytes": 300,
+                           "overlap_ratio": 0.6})
+    assert [f.rule for f in got] == ["UL302"]
+    assert got[0].severity == "error"
+    # overlap ratio regressed >5% -> error
+    got = sa.audit_overlap_budget(
+        "bert/dp", stats, {"exposed_collective_bytes": 400,
+                           "overlap_ratio": 0.8})
+    assert [f.rule for f in got] == ["UL302"]
+    # budgeted fully-overlapped: ANY exposure fires
+    got = sa.audit_overlap_budget(
+        "bert/dp", stats, {"exposed_collective_bytes": 0,
+                           "overlap_ratio": 1.0})
+    assert {f.rule for f in got} == {"UL302"}
+    # a scenario with no collectives has nothing to budget
+    assert sa.audit_overlap_budget(
+        "serve/ragged-w1", {"total_collective_bytes": 0}, None) == []
+
+
+def test_budget_entries_merge_across_passes(tmp_path):
+    """Pass-3 and Pass-4 keys share one scenario entry: refreshing
+    either pass must not erase the other's keys."""
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    path = str(tmp_path / "comms.json")
+    fp = "fmtX|test|n8|jax0"
+    hlo_audit.update_budget_entries(path, fp, {"bert/dp": {
+        "collective_bytes": {"all-reduce": 123}, "peak_bytes": 456}})
+    sa.update_schedule_budget_entries(path, fp, {"bert/dp": {
+        "overlap_ratio": 0.5, "exposed_collective_bytes": 789}})
+    entry = hlo_audit.budget_entry(hlo_audit.load_budgets(path), fp,
+                                   "bert/dp")
+    assert entry == {"collective_bytes": {"all-reduce": 123},
+                     "peak_bytes": 456, "overlap_ratio": 0.5,
+                     "exposed_collective_bytes": 789}
+    # pass3 refresh keeps pass4 keys; pass4 refresh keeps pass3 keys
+    hlo_audit.update_budget_entries(path, fp, {"bert/dp": {
+        "collective_bytes": {"all-reduce": 200}, "peak_bytes": 500}})
+    sa.update_schedule_budget_entries(path, fp, {"bert/dp": {
+        "overlap_ratio": 0.25, "exposed_collective_bytes": 1000}})
+    entry = hlo_audit.budget_entry(hlo_audit.load_budgets(path), fp,
+                                   "bert/dp")
+    assert entry == {"collective_bytes": {"all-reduce": 200},
+                     "peak_bytes": 500, "overlap_ratio": 0.25,
+                     "exposed_collective_bytes": 1000}
+
+
+def test_schedule_audit_deterministic_on_same_text():
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    text = _sched_module(_AG_START + _AG_DONE + _BIG_DOT)
+    f1, s1 = sa.audit_schedule_text(text, context="fix")
+    f2, s2 = sa.audit_schedule_text(text, context="fix")
+    assert s1 == s2
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_pass4_silent_on_healthy_zero1_compile(zero1_compiled):
+    """Acceptance: the healthy real compile is UL301/UL303-silent, and
+    its stats carry the documented CPU shape — sync collectives only,
+    every byte exposed (the before-number the item-5 overlap campaign
+    commits to push down)."""
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    _, _, compiled = zero1_compiled
+    found, stats = sa.audit_compiled_schedule(compiled,
+                                              context="bert/zero1")
+    assert found == [], "\n".join(f.render() for f in found)
+    assert stats["sync_collectives"] > 0
+    assert stats["async_pairs"] == 0
+    assert stats["overlap_ratio"] == 0.0
+    assert stats["total_collective_bytes"] > 0
+    assert stats["exposed_collective_bytes"] == \
+        stats["total_collective_bytes"]
+
+
+@pytest.mark.slow  # AOT-compiles the real step; CI's full pytest runs it
+def test_pass4_byte_totals_match_pass3(zero1_compiled):
+    """The two passes count the same collectives: Pass 4's total bytes
+    must equal the sum of Pass 3's per-kind byte budget."""
+    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis import schedule_audit as sa
+
+    _, _, compiled = zero1_compiled
+    text = compiled.as_text()
+    colls = hlo_audit.extract_collectives(text, 8)
+    _, stats = sa.audit_schedule_text(text, context="bert/zero1")
+    assert stats["total_collective_bytes"] == sum(c.bytes for c in colls)
+
+
+@pytest.mark.slow  # three subprocess AOT compiles (~2 min) — CI's full
+def test_cli_pass4_budget_roundtrip_and_schema(tmp_path):  # pytest runs it
+    budget = str(tmp_path / "comms.json")
+    report = str(tmp_path / "r1.json")
+    base = ["--no-lint", "--no-trace", "--config", "examples/bert",
+            "--cpu-devices", "8", "--pass4", "--pass3-variants", "dp",
+            "--budget-file", budget]
+    # 1) fresh budgets: --update-budgets writes and exits clean
+    proc = _run_cli(base + ["--update-budgets", "--json", report])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    r = json.loads(open(report).read())
+    assert r["pass4"]["fingerprint"]
+    assert "pass3" not in r  # --pass4 alone reports pass 4 only
+    scen = {s["scenario"]: s for s in r["pass4"]["scenarios"]}
+    assert scen["bert/dp"]["overlap_ratio"] == 0.0  # CPU: all exposed
+    assert scen["bert/dp"]["exposed_collective_bytes"] > 0
+    assert scen["bert/dp"]["sync_collectives"] > 0
+    data = json.loads(open(budget).read())
+    entry = data["budgets"][r["pass4"]["fingerprint"]]["bert/dp"]
+    assert set(entry) == {"overlap_ratio", "exposed_collective_bytes"}
+    # 2) a tightened budget (claims less exposure than reality) fails
+    entry["exposed_collective_bytes"] = int(
+        entry["exposed_collective_bytes"] * 0.5)
+    open(budget, "w").write(json.dumps(data))
+    proc = _run_cli(base + ["--json", report])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rules = {f["rule"]
+             for f in json.loads(open(report).read())["new_findings"]}
+    assert rules == {"UL302"}
+    # 3) --update-budgets accepts the measurement; clean again
+    proc = _run_cli(base + ["--update-budgets"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------
+# Budget-scenario rot surface (--check-baseline over comms_baseline)
+# ---------------------------------------------------------------------
+
+def test_known_budget_scenarios_cover_committed_file():
+    import os
+
+    from unicore_tpu.analysis.scenarios import (
+        known_budget_scenarios,
+        stale_budget_scenarios,
+    )
+
+    known = known_budget_scenarios()
+    assert "bert/zero1" in known and "bert/fsdp2-uf1" in known
+    assert any(s.startswith("serve/ragged-w") for s in known)
+    committed = os.path.join(_repo_root(), "tools",
+                             "comms_baseline.json")
+    assert stale_budget_scenarios(committed) == []
+
+
+def test_stale_budget_scenarios_flags_rot(tmp_path):
+    from unicore_tpu.analysis.scenarios import stale_budget_scenarios
+
+    path = str(tmp_path / "comms.json")
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "budgets": {
+            "fp-a": {"bert/dp": {}, "serve/prefill-b8": {}},
+            "fp-b": {"bert/gone2": {}},
+        }}, fh)
+    assert stale_budget_scenarios(path) == [
+        ("fp-a", "serve/prefill-b8"), ("fp-b", "bert/gone2")]
+    # absent file: nothing to check
+    assert stale_budget_scenarios(str(tmp_path / "nope.json")) == []
+
+
+@pytest.mark.slow  # subprocess + serve-engine build; CI runs it
+def test_cli_check_baseline_flags_budget_rot(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    rotten = tmp_path / "comms.json"
+    rotten.write_text(json.dumps({"version": 1, "budgets": {
+        "fmt1|cpu|n8|jax0.4.37": {"serve/prefill-b8": {
+            "peak_bytes": 1}}}}))
+    base = [sys.executable, "-m", "unicore_tpu.analysis", "--no-trace",
+            "-q", "--lint-root", str(clean), "--no-baseline",
+            "--budget-file", str(rotten)]
+    proc = subprocess.run(
+        base + ["--check-baseline"], cwd=_repo_root(),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale budget scenario" in proc.stdout
+    # without --check-baseline the same rot passes silently
+    proc = subprocess.run(
+        base, cwd=_repo_root(), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------
+# UL115 — unjoined daemon thread
+# ---------------------------------------------------------------------
+
+def test_ul115_fires_on_unstopped_daemon_worker(tmp_path):
+    found = _lint_snippet(tmp_path, "w.py", """
+        import threading
+        class Worker:
+            def go(self):
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True)
+                self._thread.start()
+    """)
+    assert "UL115" in rules_of(found)
+
+
+def test_ul115_fires_on_chained_fire_and_forget(tmp_path):
+    found = _lint_snippet(tmp_path, "w.py", """
+        import threading
+        def kick(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    fired = [f for f in found if f.rule == "UL115"]
+    assert fired and "drops the only reference" in fired[0].message
+
+
+def test_ul115_silent_with_shutdown_method(tmp_path):
+    # the watchdog shape: close() stops the worker with a flag, no join
+    found = _lint_snippet(tmp_path, "w.py", """
+        import threading
+        class Worker:
+            def go(self):
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True)
+                self._thread.start()
+            def close(self):
+                self._stop = True
+    """)
+    assert "UL115" not in rules_of(found)
+
+
+def test_ul115_silent_with_join(tmp_path):
+    found = _lint_snippet(tmp_path, "w.py", """
+        from threading import Thread
+        def run_briefly(fn):
+            t = Thread(target=fn, daemon=True)
+            t.start()
+            t.join(timeout=1.0)
+    """)
+    assert "UL115" not in rules_of(found)
+
+
+def test_ul115_silent_on_non_daemon_thread(tmp_path):
+    # a non-daemon thread blocks exit visibly instead of losing work
+    found = _lint_snippet(tmp_path, "w.py", """
+        import threading
+        def go(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """)
+    assert "UL115" not in rules_of(found)
+
+
+def test_ul115_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "w.py", """
+        import threading
+        def kick(fn):
+            threading.Thread(target=fn, daemon=True).start()  # unicore-lint: disable=UL115
+    """)
+    assert "UL115" not in rules_of(found)
+
+
+def test_ul115_repo_sweep_clean():
+    """async_writer, prefetch pump, watchdog, and the fleet router are
+    the intended-clean worker spawns — each owns a stop/close/drain
+    shutdown path."""
+    import os
+
+    root = _repo_root()
+    found = [
+        f for f in lint_paths(
+            [os.path.join(root, "unicore_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "tools")],
+            rel_to=root,
+        )
+        if f.rule == "UL115"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)

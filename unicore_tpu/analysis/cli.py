@@ -9,6 +9,10 @@ Runs all passes and reports machine-readable JSON plus human text:
                            jitted programs and audit the optimized
                            HLO's collectives + memory against
                            tools/comms_baseline.json
+  Pass 4 (schedule audit)  --pass4 [--pass4-serve]: parse the same
+                           compiled modules' SCHEDULED text and audit
+                           collective/compute overlap (UL301-UL303)
+                           against the same budget file
 
 Exit code 0 when no findings outside the baseline, 1 otherwise.  CI
 pins the baseline (``tools/lint_baseline.json``) so only NEW findings
@@ -79,8 +83,10 @@ def build_parser():
         help="fail when the baseline contains suppressions that no "
              "longer fire (baseline rot); scoped to the rule families "
              "this invocation runs (trace UL0xx, lint UL1xx, pass-3 "
-             "UL2xx), so a partial run never false-flags entries it "
-             "could not have re-fired",
+             "UL2xx, pass-4 UL3xx), so a partial run never false-flags "
+             "entries it could not have re-fired; also fails on budget "
+             "rot — comms_baseline.json entries for scenarios that no "
+             "longer exist in scenarios.py",
     )
     p.add_argument(
         "--pass3", action="store_true",
@@ -95,6 +101,19 @@ def build_parser():
              "the sampling variants (Pass-1 rules included) "
              "and audit recompile surface + budgets (UL205, "
              "UL202/UL203)",
+    )
+    p.add_argument(
+        "--pass4", action="store_true",
+        help="Pass 4: parse the scheduled optimized-HLO text of the "
+             "--config train step per mesh variant and audit "
+             "collective/compute overlap (UL301/UL303) plus the "
+             "per-scenario overlap budget (UL302); shares its "
+             "compiles with --pass3 when both are requested",
+    )
+    p.add_argument(
+        "--pass4-serve", action="store_true",
+        help="Pass 4 over the demo ServeEngine's ragged-step "
+             "executables (shares compiles with --pass3-serve)",
     )
     p.add_argument(
         "--pass3-variants", default=None, metavar="CSV",
@@ -166,7 +185,8 @@ def main(argv=None):
 
     needs_jax = (
         (args.config and not args.no_trace) or args.pass3
-        or args.pass3_serve or args.fused_head_audit
+        or args.pass3_serve or args.pass4 or args.pass4_serve
+        or args.fused_head_audit
     )
     if needs_jax and args.cpu_devices:
         _provision_cpu_devices(args.cpu_devices)
@@ -217,17 +237,21 @@ def main(argv=None):
                 f"{len(per['naive'])})"
             )
 
-    if args.pass3 or args.pass3_serve:
+    pass4_report = None
+    budget_path = args.budget_file or os.path.join(
+        anchor, os.path.join("tools", "comms_baseline.json")
+    )
+    if args.pass3 or args.pass3_serve or args.pass4 or args.pass4_serve:
         from unicore_tpu.analysis import hlo_audit
 
-        budget_path = args.budget_file or os.path.join(
-            anchor, hlo_audit.DEFAULT_BUDGET_FILE
-        )
-        pass3_report = {"budget_file": budget_path, "scenarios": []}
-        if args.pass3:
+        if args.pass3 or args.pass3_serve:
+            pass3_report = {"budget_file": budget_path, "scenarios": []}
+        if args.pass4 or args.pass4_serve:
+            pass4_report = {"budget_file": budget_path, "scenarios": []}
+        if args.pass3 or args.pass4:
             if not args.config:
-                print("unicore-lint: error: --pass3 needs --config",
-                      file=sys.stderr)
+                print("unicore-lint: error: --pass3/--pass4 need "
+                      "--config", file=sys.stderr)
                 return 2
             from unicore_tpu.analysis.scenarios import (
                 audit_bert_config_pass3,
@@ -240,21 +264,37 @@ def main(argv=None):
                 n_devices=args.cpu_devices or None,
                 budget_path=budget_path,
                 update_budgets=args.update_budgets, log=log,
+                pass3=args.pass3, schedule=args.pass4,
             )
             findings.extend(got)
-            pass3_report["fingerprint"] = rep["fingerprint"]
-            pass3_report["scenarios"].extend(rep["scenarios"])
-        if args.pass3_serve:
+            if args.pass3:
+                pass3_report["fingerprint"] = rep["fingerprint"]
+                pass3_report["scenarios"].extend(rep["scenarios"])
+            if args.pass4:
+                pass4_report["fingerprint"] = rep["fingerprint"]
+                pass4_report["scenarios"].extend(
+                    rep["schedule_scenarios"]
+                )
+        if args.pass3_serve or args.pass4_serve:
             from unicore_tpu.analysis.scenarios import audit_serve_demo
 
             got, rep = audit_serve_demo(
                 budget_path=budget_path,
                 update_budgets=args.update_budgets,
                 thresholds=thresholds, log=log,
+                pass3=args.pass3_serve, schedule=args.pass4_serve,
             )
             findings.extend(got)
-            pass3_report.setdefault("fingerprint", rep["fingerprint"])
-            pass3_report["scenarios"].extend(rep["scenarios"])
+            if args.pass3_serve:
+                pass3_report.setdefault("fingerprint",
+                                        rep["fingerprint"])
+                pass3_report["scenarios"].extend(rep["scenarios"])
+            if args.pass4_serve:
+                pass4_report.setdefault("fingerprint",
+                                        rep["fingerprint"])
+                pass4_report["scenarios"].extend(
+                    rep["schedule_scenarios"]
+                )
         if (args.update_budgets and args.pass3 and args.pass3_serve
                 and not args.pass3_variants
                 and pass3_report.get("fingerprint")):
@@ -317,6 +357,8 @@ def main(argv=None):
             ran.add("UL1")
         if args.pass3 or args.pass3_serve:
             ran.add("UL2")
+        if args.pass4 or args.pass4_serve:
+            ran.add("UL3")
         stale = [
             e for e in stale_baseline_entries(baseline_path, findings)
             if str(e.get("rule", ""))[:3] in ran
@@ -329,13 +371,37 @@ def main(argv=None):
                 f"--write-baseline",
             )
 
+    stale_budget = []
+    if args.check_baseline and os.path.exists(budget_path):
+        # the budget file rots the same way: a scenario renamed or
+        # removed in scenarios.py leaves dead entries behind in every
+        # fingerprint section — fail on them instead of letting a
+        # reviewed file accumulate fiction
+        from unicore_tpu.analysis.scenarios import stale_budget_scenarios
+
+        stale_budget = stale_budget_scenarios(budget_path)
+        for fp_key, scenario in stale_budget:
+            print(
+                f"{budget_path}: stale budget scenario '{scenario}' "
+                f"(fingerprint {fp_key}) — no such scenario exists in "
+                f"scenarios.py; remove the entry or restore the "
+                f"scenario",
+            )
+
     extra = {"trace": trace_reports}
     if pass3_report is not None:
         extra["pass3"] = pass3_report
+    if pass4_report is not None:
+        extra["pass4"] = pass4_report
     if fused_head_report is not None:
         extra["fused_head_audit"] = fused_head_report
     if stale:
         extra["stale_baseline"] = stale
+    if stale_budget:
+        extra["stale_budget_scenarios"] = [
+            {"fingerprint": fp_key, "scenario": s}
+            for fp_key, s in stale_budget
+        ]
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(report_json(new, suppressed, extra=extra),
@@ -345,9 +411,13 @@ def main(argv=None):
     if stale:
         print(f"unicore-lint: {len(stale)} stale baseline "
               f"suppression(s) (baseline rot)")
+    if stale_budget:
+        print(f"unicore-lint: {len(stale_budget)} stale budget "
+              f"scenario entr(ies) (budget rot)")
     if fused_head_failed:
         print("unicore-lint: fused-head memory audit FAILED")
-    return 1 if (new or stale or fused_head_failed) else 0
+    return 1 if (new or stale or stale_budget or fused_head_failed) \
+        else 0
 
 
 if __name__ == "__main__":

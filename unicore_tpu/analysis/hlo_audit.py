@@ -436,17 +436,20 @@ def write_budgets(path, data):
 
 
 def update_budget_entries(path, fingerprint, scenario_stats):
-    """Replace the ``fingerprint`` section's entries for the measured
-    scenarios; other fingerprints' sections are kept verbatim (they
-    self-invalidate by never being read in this environment)."""
+    """Refresh the ``fingerprint`` section's Pass-3 keys for the
+    measured scenarios; other fingerprints' sections are kept verbatim
+    (they self-invalidate by never being read in this environment).
+    MERGES into existing entries rather than replacing them — the same
+    scenario's Pass-4 overlap keys (``schedule_audit``) share the entry
+    and must survive a pass3-only refresh."""
     data = load_budgets(path)
     data.setdefault("version", BUDGET_VERSION)
     section = data.setdefault("budgets", {}).setdefault(fingerprint, {})
     for scenario, stats in scenario_stats.items():
-        section[scenario] = {
+        section.setdefault(scenario, {}).update({
             "collective_bytes": dict(stats.get("collective_bytes", {})),
             "peak_bytes": stats.get("peak_bytes"),
-        }
+        })
     write_budgets(path, data)
     return data
 

@@ -228,23 +228,29 @@ def compile_variant(example_dir, overrides, devices, *,
 
 def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                             budget_path=None, update_budgets=False,
-                            tolerance=None, log=None):
-    """Pass-3 compiled-HLO audit over the bert config's mesh variants.
+                            tolerance=None, log=None,
+                            pass3=True, schedule=False):
+    """Pass-3/Pass-4 compiled-HLO audit over the bert config's mesh
+    variants — ONE compile per variant feeds both passes.
 
-    Per variant: compile the real train step, extract its collectives,
-    run UL201 (fsdp engagement), and check UL202/UL203 against the
-    committed budget file.  Match groups (``PASS3_MATCH_GROUPS``) then
-    compile their extra members and run UL204.  With ``update_budgets``
-    the measured stats replace the budget entries for the current
-    environment fingerprint BEFORE the budget rules evaluate, so an
-    accepted change leaves the run clean.
+    Per variant: compile the real train step; with ``pass3`` extract
+    its collectives, run UL201 (fsdp engagement), and check
+    UL202/UL203 against the committed budget file; with ``schedule``
+    parse the scheduled module text, run UL301/UL303 over the async
+    start/done windows, and check the overlap stats against the same
+    budget entries (UL302).  Match groups (``PASS3_MATCH_GROUPS``)
+    then compile their extra members and run UL204 (pass3 only).  With
+    ``update_budgets`` the measured stats refresh the budget entries
+    for the current environment fingerprint BEFORE the budget rules
+    evaluate, so an accepted change leaves the run clean.
 
-    Returns (findings, report) where report carries the fingerprint and
-    per-scenario stats for the JSON report.
+    Returns (findings, report): report carries the fingerprint,
+    per-scenario Pass-3 stats (``scenarios``), and per-scenario Pass-4
+    schedule stats (``schedule_scenarios``) for the JSON report.
     """
     import jax
 
-    from unicore_tpu.analysis import hlo_audit
+    from unicore_tpu.analysis import hlo_audit, schedule_audit
 
     avail = jax.devices()
     if n_devices is None:
@@ -263,43 +269,58 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
         )
     findings = []
     scenario_stats = {}
+    schedule_stats = {}
     colls_by_scenario = {}
     snap = snapshot_globals()
     scenarios_report = []
+    schedule_report = []
     try:
         for name in wanted:
             overrides, min_dev = variant_map[name]
             if len(devices) < min_dev or len(devices) % max(min_dev, 1):
-                scenarios_report.append({
+                skip = {
                     "scenario": f"bert/{name}",
                     "skipped": f"needs {min_dev} devices, have "
                                f"{len(devices)}",
-                })
+                }
+                if pass3:
+                    scenarios_report.append(skip)
+                if schedule:
+                    schedule_report.append(dict(skip))
                 continue
             ctx = f"bert/{name}"
             if log:
-                log(f"pass3: compiling {ctx}")
+                log(f"pass{'3' if pass3 else '4'}: compiling {ctx}")
             trainer, art, compiled = compile_variant(
                 example_dir, overrides, devices
             )
-            got, stats, colls = hlo_audit.audit_compiled(
-                compiled, context=ctx, mesh=trainer.mesh,
-                params=art["state"]["params"], num_devices=len(devices),
-            )
-            findings.extend(got)
-            if overrides.get("zero1"):
-                # certify the sharded-update group signature (and fire
-                # when the spec disengaged — moments replicated despite
-                # --zero1)
-                findings.extend(hlo_audit.audit_zero1_collectives(
-                    trainer.mesh, colls, art["state"]["params"],
-                    context=ctx,
-                ))
-            scenario_stats[ctx] = stats
-            colls_by_scenario[ctx] = colls
-            scenarios_report.append({"scenario": ctx, **stats})
+            if pass3:
+                got, stats, colls = hlo_audit.audit_compiled(
+                    compiled, context=ctx, mesh=trainer.mesh,
+                    params=art["state"]["params"],
+                    num_devices=len(devices),
+                )
+                findings.extend(got)
+                if overrides.get("zero1"):
+                    # certify the sharded-update group signature (and
+                    # fire when the spec disengaged — moments
+                    # replicated despite --zero1)
+                    findings.extend(hlo_audit.audit_zero1_collectives(
+                        trainer.mesh, colls, art["state"]["params"],
+                        context=ctx,
+                    ))
+                scenario_stats[ctx] = stats
+                colls_by_scenario[ctx] = colls
+                scenarios_report.append({"scenario": ctx, **stats})
+            if schedule:
+                got, sstats = schedule_audit.audit_compiled_schedule(
+                    compiled, context=ctx,
+                )
+                findings.extend(got)
+                schedule_stats[ctx] = sstats
+                schedule_report.append({"scenario": ctx, **sstats})
 
-        for group_name, members in PASS3_MATCH_GROUPS:
+        for group_name, members in PASS3_MATCH_GROUPS if pass3 else ():
             # a restricted --pass3-variants run only pays for the match
             # groups it asked for: skip groups none of whose members'
             # base variants were requested
@@ -342,6 +363,13 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
             if log:
                 log(f"pass3: wrote {len(scenario_stats)} budget "
                     f"entr(ies) to {budget_path}")
+        if update_budgets and schedule_stats:
+            schedule_audit.update_schedule_budget_entries(
+                budget_path, fp, schedule_stats
+            )
+            if log:
+                log(f"pass4: wrote {len(schedule_stats)} overlap "
+                    f"budget entr(ies) to {budget_path}")
         budgets = hlo_audit.load_budgets(budget_path)
         for ctx, stats in scenario_stats.items():
             entry = hlo_audit.budget_entry(budgets, fp, ctx)
@@ -351,8 +379,49 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
             findings.extend(hlo_audit.audit_memory_budget(
                 ctx, stats.get("peak_bytes"), entry, tolerance=tol
             ))
-    report = {"fingerprint": fp, "scenarios": scenarios_report}
+        for ctx, sstats in schedule_stats.items():
+            entry = hlo_audit.budget_entry(budgets, fp, ctx)
+            findings.extend(schedule_audit.audit_overlap_budget(
+                ctx, sstats, entry, tolerance=tol
+            ))
+    report = {"fingerprint": fp, "scenarios": scenarios_report,
+              "schedule_scenarios": schedule_report}
     return findings, report
+
+
+def known_budget_scenarios():
+    """Every scenario name a budget-file entry may legitimately carry:
+    the bert mesh variants, the match-group extra members, and the demo
+    serve surface (both ragged widths + the width-1 sampling variants).
+    ``--check-baseline`` fails on any ``comms_baseline.json`` entry
+    outside this set — a renamed variant or removed serve width must
+    not rot in a reviewed file (the PR-13 stale-serve-section cleanup,
+    made structural)."""
+    names = {f"bert/{name}" for name, _, _ in MESH_VARIANTS + ZERO1_VARIANTS}
+    for _, members in PASS3_MATCH_GROUPS:
+        names.update(f"bert/{suffix}" for suffix, _, _ in members)
+    engine = build_demo_serve_engine()
+    names.update(f"serve/ragged-w{w}" for w in engine.serve_step_widths())
+    names.update(f"serve/decode-{s}" for s in ("temp", "topk"))
+    return names
+
+
+def stale_budget_scenarios(budget_path):
+    """[(fingerprint, scenario), ...] for budget entries whose scenario
+    no longer exists — checked across ALL fingerprint sections, because
+    a scenario rename rots every environment's entries at once."""
+    from unicore_tpu.analysis import hlo_audit
+
+    budgets = hlo_audit.load_budgets(budget_path).get("budgets", {})
+    if not budgets:
+        return []
+    known = known_budget_scenarios()
+    return [
+        (fp, scenario)
+        for fp, section in sorted(budgets.items())
+        for scenario in sorted(section)
+        if scenario not in known
+    ]
 
 
 def build_demo_serve_engine(seed=1):
@@ -369,8 +438,9 @@ def build_demo_serve_engine(seed=1):
 
 def audit_serve_demo(*, budget_path=None, update_budgets=False,
                      tolerance=None, thresholds=None, log=None,
-                     engine=None):
-    """Pass 1 + Pass 3 over the demo ServeEngine's unified ragged jits.
+                     engine=None, pass3=True, schedule=False):
+    """Pass 1 + Pass 3 (and/or Pass 4) over the demo ServeEngine's
+    unified ragged jits — one compile per executable feeds every pass.
 
     The engine's compile surface is CONSTANT since the ragged
     unification: two widths of ONE step function (the pure-decode
@@ -379,18 +449,22 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
     chunk size the admission can produce and fails on any width
     outside the declared set.  Every executable is traced,
     donation/jaxpr-audited, and compiled for the budget rules —
-    without executing on device.  Returns (findings, report).
+    without executing on device.  With ``schedule`` the scheduled
+    module text additionally runs the Pass-4 overlap audit
+    (UL301/UL302/UL303).  Returns (findings, report).
     """
-    from unicore_tpu.analysis import hlo_audit, trace_audit
+    from unicore_tpu.analysis import hlo_audit, schedule_audit, trace_audit
     from unicore_tpu.analysis.trace_audit import audit_donation, audit_jaxpr
 
     th = dict(thresholds or {})
     engine = engine or build_demo_serve_engine()
     tol = hlo_audit.DEFAULT_TOLERANCE if tolerance is None else tolerance
-    findings = list(hlo_audit.audit_serve_recompiles(
-        engine.width_fn, engine.serve_step_widths(),
-        engine.prefill_chunk,
-    ))
+    findings = []
+    if pass3:
+        findings.extend(hlo_audit.audit_serve_recompiles(
+            engine.width_fn, engine.serve_step_widths(),
+            engine.prefill_chunk,
+        ))
     # every executable serve_step can dispatch: both widths under the
     # default greedy composition, plus the width-1 program under each
     # sampling variant (the variants differ only in the _pick_tokens
@@ -402,26 +476,39 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
         got = engine.trace_step_fns(sampling=sampling, widths=(1,))
         arts[f"decode-{sampling}"] = got["ragged-w1"]
     scenario_stats = {}
+    schedule_stats = {}
     scenarios_report = []
+    schedule_report = []
     for name, art in sorted(arts.items()):
         ctx = f"serve/{name}"
         if log:
-            log(f"pass3: compiling {ctx}")
-        findings.extend(audit_jaxpr(
-            art["jaxpr"], context=ctx,
-            big_bytes=th.get("big_bytes", trace_audit.DEFAULT_BIG_BYTES),
-            quad_bytes=th.get("quad_bytes",
-                              trace_audit.DEFAULT_QUAD_BYTES),
-            upcast_min_elems=th.get(
-                "upcast_min_elems", trace_audit.DEFAULT_UPCAST_MIN_ELEMS
-            ),
-            pedantic=th.get("pedantic", False),
-        ))
-        findings.extend(audit_donation(art["lowered"], context=ctx))
+            log(f"pass{'3' if pass3 else '4'}: compiling {ctx}")
+        if pass3:
+            findings.extend(audit_jaxpr(
+                art["jaxpr"], context=ctx,
+                big_bytes=th.get("big_bytes",
+                                 trace_audit.DEFAULT_BIG_BYTES),
+                quad_bytes=th.get("quad_bytes",
+                                  trace_audit.DEFAULT_QUAD_BYTES),
+                upcast_min_elems=th.get(
+                    "upcast_min_elems",
+                    trace_audit.DEFAULT_UPCAST_MIN_ELEMS
+                ),
+                pedantic=th.get("pedantic", False),
+            ))
+            findings.extend(audit_donation(art["lowered"], context=ctx))
         compiled = art["lowered"].compile()
-        _, stats, _ = hlo_audit.audit_compiled(compiled, context=ctx)
-        scenario_stats[ctx] = stats
-        scenarios_report.append({"scenario": ctx, **stats})
+        if pass3:
+            _, stats, _ = hlo_audit.audit_compiled(compiled, context=ctx)
+            scenario_stats[ctx] = stats
+            scenarios_report.append({"scenario": ctx, **stats})
+        if schedule:
+            got, sstats = schedule_audit.audit_compiled_schedule(
+                compiled, context=ctx,
+            )
+            findings.extend(got)
+            schedule_stats[ctx] = sstats
+            schedule_report.append({"scenario": ctx, **sstats})
 
     fp = None
     if budget_path is not None:
@@ -429,6 +516,10 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
         if update_budgets and scenario_stats:
             hlo_audit.update_budget_entries(budget_path, fp,
                                             scenario_stats)
+        if update_budgets and schedule_stats:
+            schedule_audit.update_schedule_budget_entries(
+                budget_path, fp, schedule_stats
+            )
         budgets = hlo_audit.load_budgets(budget_path)
         for ctx, stats in scenario_stats.items():
             entry = hlo_audit.budget_entry(budgets, fp, ctx)
@@ -438,7 +529,13 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
             findings.extend(hlo_audit.audit_memory_budget(
                 ctx, stats.get("peak_bytes"), entry, tolerance=tol
             ))
-    return findings, {"fingerprint": fp, "scenarios": scenarios_report}
+        for ctx, sstats in schedule_stats.items():
+            entry = hlo_audit.budget_entry(budgets, fp, ctx)
+            findings.extend(schedule_audit.audit_overlap_budget(
+                ctx, sstats, entry, tolerance=tol
+            ))
+    return findings, {"fingerprint": fp, "scenarios": scenarios_report,
+                      "schedule_scenarios": schedule_report}
 
 
 def audit_fused_head_memory(example_dir, *, variants=None, n_devices=None,

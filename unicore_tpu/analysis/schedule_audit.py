@@ -1,0 +1,428 @@
+"""Pass 4: compiled-SCHEDULE audit — collective/compute overlap.
+
+Pass 3 audits *which* collectives the compiled step runs and how many
+bytes they move (UL201-UL205).  It is blind to *when* they run: a
+scheduler regression that serializes every reduce-scatter into a step
+tail moves the same bytes past the same budgets while erasing the
+overlap that hides their latency behind compute.  Exposed
+(non-overlapped) collective time is exactly the overhead the ROADMAP
+item-5 MFU campaign must erase (the concurrency framing of arxiv
+2011.03641; the weight-update-sharding cost model of arxiv 2004.13336),
+so this pass parses the *scheduled* optimized-HLO module — the
+instruction order inside each computation IS the execution order once
+``is_scheduled=true`` — matches every async ``*-start``/``*-done``
+pair, and attributes the compute scheduled inside each start/done
+window to that collective's overlap budget.
+
+Rules (UL3xx family, locations ``hlo:<scenario>``):
+
+- UL301 exposed-collective: a float collective whose start/done window
+  contains no compute above a floor (it serializes) in a computation
+  where overlappable compute exists.  Structurally tail-positioned
+  collectives — nothing above the compute floor is scheduled after
+  their ``done`` (the ZeRO-1 param all-gather feeding only the step's
+  returned state) — are whitelisted: there is no compute left to hide
+  them behind.  An ``op_name`` regex whitelist covers collectives that
+  are tail-positioned by construction even when a trailing fusion
+  blurs the structural test.
+- UL302 overlap-budget: per-scenario ``overlap_ratio``
+  (overlapped-collective-bytes / total-collective-bytes) and
+  ``exposed_collective_bytes`` against the committed budget file
+  (``tools/comms_baseline.json``, same fingerprint-keyed sections as
+  UL202/UL203); a >tolerance regression on either fails, and
+  ``--update-budgets`` refreshes both keys in place.
+- UL303 async-pair-integrity: an async ``-start`` no ``-done`` ever
+  consumes, a ``-done`` whose operand is not a known start, a pair
+  whose done is scheduled BEFORE its start (corrupt schedule), and a
+  done that is its start's immediate successor (zero-width window —
+  the async form bought nothing).
+
+XLA:CPU caveat: the CPU backend emits ``is_scheduled=true`` modules
+but lowers every collective SYNCHRONOUSLY — no ``-start``/``-done``
+pairs exist, so on the CPU audit host every collective byte is exposed
+by construction (``overlap_ratio`` 0.0, ``exposed_collective_bytes``
+== total).  That is semantically honest — it is the same serialization
+``zero1_step_overhead_ratio`` measures in bench — and it is the
+committed before-number the overlap campaign will push down on a real
+TPU backend, where the async pairs appear and this pass's window
+attribution becomes the regression gate.
+"""
+
+import re
+from typing import List, Optional
+
+from unicore_tpu.analysis.findings import Finding
+from unicore_tpu.analysis.hlo_audit import (
+    COLLECTIVE_KINDS,
+    DEFAULT_TOLERANCE,
+    _shape_bytes,
+    load_budgets,
+    write_budgets,
+)
+
+# a start/done window "contains compute" when the instructions inside
+# it sum to at least one of these floors — a lone bitcast or tuple
+# shuffle does not hide a collective's latency
+DEFAULT_MIN_WINDOW_FLOPS = 4096
+DEFAULT_MIN_WINDOW_BYTES = 16384
+
+# op_name metadata patterns for collectives that are tail-positioned by
+# construction (the ZeRO-1 updated-param gather feeding only the step's
+# returned state): exposed by design until the item-5 overlap work
+# moves them, and whitelisted so UL301 stays a scheduler-regression
+# tripwire rather than a standing alarm
+DEFAULT_UL301_WHITELIST = (
+    r"zero1",
+    r"param[-_/]?gather",
+)
+
+# opcodes whose presence inside a window counts as overlappable compute
+_COMPUTE_OPS = frozenset((
+    "dot", "convolution", "fusion", "custom-call", "reduce",
+    "scatter", "select-and-scatter", "sort", "cholesky",
+    "triangular-solve",
+))
+
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<op>[a-z][a-z0-9\-]*)\("
+)
+_COMP_RE = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%(?P<name>[\w.\-]+)\s*\(.*\{\s*$"
+)
+_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{(?P<dims>[0-9,]*)\}")
+_OP_NAME_RE = re.compile(r'op_name="(?P<name>[^"]*)"')
+_SHAPE_DIMS_RE = re.compile(r"[a-z][a-z0-9]*\[(?P<dims>[0-9,]*)\]")
+
+
+class Instr:
+    """One scheduled instruction: opcode + result shape + the pieces
+    the overlap attribution needs (pre-chewed, the 4 MB module text is
+    walked once)."""
+
+    __slots__ = ("name", "op", "shape", "bytes", "is_float", "flops",
+                 "kind", "is_start", "is_done", "first_operand",
+                 "op_name", "index")
+
+    def __init__(self, name, op, shape, line, index):
+        self.name = name
+        self.op = op
+        self.shape = shape
+        self.index = index
+        base, self.is_start, self.is_done = op, False, False
+        if op.endswith("-start"):
+            base, self.is_start = op[:-len("-start")], True
+        elif op.endswith("-done"):
+            base, self.is_done = op[:-len("-done")], True
+        # base-name match covers plain sync ops and -start/-done forms;
+        # generic async-start/-done wrappers name their collective in
+        # the calls= target, so the line scan classifies those
+        self.kind = next((k for k in COLLECTIVE_KINDS if base == k), None)
+        if self.kind is None and base == "async":
+            self.kind = next(
+                (k for k in COLLECTIVE_KINDS if k in line), None
+            )
+        # -start result tuples alias the operand next to the output;
+        # summing would double-count the transfer (same rule Pass 3 uses)
+        self.bytes, _, self.is_float = _shape_bytes(
+            shape, largest_only=self.is_start
+        )
+        m = _OP_NAME_RE.search(line)
+        self.op_name = m.group("name") if m else ""
+        self.first_operand = None
+        if self.is_done:
+            args = line.split(op + "(", 1)
+            if len(args) == 2:
+                m = re.search(r"%([\w.\-]+)", args[1])
+                if m:
+                    self.first_operand = m.group(1)
+        self.flops = self._estimate_flops(line) if op in _COMPUTE_OPS else 0
+
+    def _estimate_flops(self, line):
+        dims = [int(d) for m in _SHAPE_DIMS_RE.finditer(self.shape)
+                for d in m.group("dims").split(",") if d]
+        elems = 1
+        for d in dims:
+            elems *= d
+        if self.op == "dot":
+            contract = 1
+            m = _LHS_CONTRACT_RE.search(line)
+            args = line.split(self.op + "(", 1)
+            lhs = _SHAPE_DIMS_RE.search(args[1]) if len(args) == 2 else None
+            if m is not None and lhs is not None:
+                lhs_dims = [int(d) for d in
+                            lhs.group("dims").split(",") if d]
+                for i in (int(x) for x in m.group("dims").split(",") if x):
+                    if i < len(lhs_dims):
+                        contract *= lhs_dims[i]
+            return 2 * elems * max(contract, 1)
+        # fusions/reductions/custom kernels: an elementwise-scale
+        # estimate — enough to clear the window floor, never mistaken
+        # for matmul throughput
+        return elems
+
+    @property
+    def is_compute(self):
+        return self.op in _COMPUTE_OPS
+
+
+class Computation:
+    __slots__ = ("name", "is_entry", "instrs")
+
+    def __init__(self, name, is_entry):
+        self.name = name
+        self.is_entry = is_entry
+        self.instrs: List[Instr] = []
+
+
+def parse_schedule(hlo_text) -> List[Computation]:
+    """The module text as ordered per-computation instruction lists.
+    With ``is_scheduled=true`` (asserted by the compile pipeline on
+    every backend this audit runs) each list IS the execution order."""
+    comps: List[Computation] = []
+    cur: Optional[Computation] = None
+    for line in hlo_text.splitlines():
+        if cur is None:
+            m = _COMP_RE.match(line)
+            if m and not line.startswith("HloModule"):
+                cur = Computation(m.group("name"),
+                                  bool(m.group("entry")))
+            continue
+        if line.startswith("}"):
+            comps.append(cur)
+            cur = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        cur.instrs.append(Instr(
+            m.group("name"), m.group("op"), m.group("shape"), line,
+            len(cur.instrs),
+        ))
+    if cur is not None:  # unterminated tail (truncated dump): keep it
+        comps.append(cur)
+    return comps
+
+
+def match_async_pairs(comp):
+    """(pairs, unmatched_starts, orphan_dones, crossed) for one
+    computation.  Matching is by OPERAND, not nesting: a ``-done``
+    names its ``-start`` as first argument, so healthy interleaving
+    (s1 s2 d1 d2) pairs correctly and a done textually BEFORE its
+    start is detected as schedule corruption rather than mis-paired."""
+    starts = {i.name: i for i in comp.instrs if i.is_start}
+    pairs, orphan_dones, crossed, claimed = [], [], [], set()
+    for ins in comp.instrs:
+        if not ins.is_done:
+            continue
+        start = starts.get(ins.first_operand)
+        if start is None:
+            orphan_dones.append(ins)
+            continue
+        claimed.add(start.name)
+        if ins.index < start.index:
+            crossed.append((start, ins))
+        else:
+            pairs.append((start, ins))
+    unmatched = [s for s in starts.values() if s.name not in claimed]
+    return pairs, unmatched, orphan_dones, crossed
+
+
+def _window_compute(comp, start, done, *, min_flops, min_bytes):
+    """(flops, bytes, above_floor) for the instructions scheduled
+    inside one start/done window."""
+    flops = nbytes = 0
+    for ins in comp.instrs[start.index + 1:done.index]:
+        if ins.is_compute:
+            flops += ins.flops
+            nbytes += ins.bytes
+    return flops, nbytes, (flops >= min_flops or nbytes >= min_bytes)
+
+
+def audit_schedule_text(hlo_text, *, context,
+                        min_window_flops=DEFAULT_MIN_WINDOW_FLOPS,
+                        min_window_bytes=DEFAULT_MIN_WINDOW_BYTES,
+                        whitelist=DEFAULT_UL301_WHITELIST):
+    """UL301 + UL303 over one compiled module's scheduled text, plus
+    the per-scenario overlap stats UL302 budgets.  Returns
+    (findings, stats)."""
+    location = f"hlo:{context}"
+    findings = []
+    stats = {
+        "schedule_ops": 0,
+        "async_pairs": 0,
+        "async_collectives": 0,
+        "sync_collectives": 0,
+        "zero_width_pairs": 0,
+        "total_collective_bytes": 0,
+        "overlapped_collective_bytes": 0,
+        "window_flops": 0,
+    }
+    wl = [re.compile(p, re.IGNORECASE) for p in whitelist]
+    for comp in parse_schedule(hlo_text):
+        stats["schedule_ops"] += len(comp.instrs)
+        # sync collectives (XLA:CPU lowers every collective this way):
+        # all bytes exposed by construction
+        for ins in comp.instrs:
+            if ins.kind and not (ins.is_start or ins.is_done):
+                stats["sync_collectives"] += 1
+                stats["total_collective_bytes"] += ins.bytes
+
+        pairs, unmatched, orphans, crossed = match_async_pairs(comp)
+        for s in unmatched:
+            findings.append(Finding(
+                "UL303", "async-pair-integrity", "error", location,
+                f"async {s.op} '{s.name}' in computation '{comp.name}' "
+                f"has no matching -done — the transfer is never awaited "
+                f"(dead async op or a truncated schedule)",
+            ))
+        for d in orphans:
+            findings.append(Finding(
+                "UL303", "async-pair-integrity", "error", location,
+                f"{d.op} '{d.name}' in computation '{comp.name}' names "
+                f"no known -start ('{d.first_operand}') — start/done "
+                f"pairing is broken",
+            ))
+        for s, d in crossed:
+            findings.append(Finding(
+                "UL303", "async-pair-integrity", "error", location,
+                f"'{d.name}' is scheduled BEFORE its start '{s.name}' "
+                f"in computation '{comp.name}' — the schedule awaits a "
+                f"transfer that has not been issued",
+            ))
+
+        has_compute = any(
+            ins.is_compute and (ins.flops >= min_window_flops
+                                or ins.bytes >= min_window_bytes)
+            for ins in comp.instrs
+        )
+        for s, d in pairs:
+            stats["async_pairs"] += 1
+            if d.index == s.index + 1:
+                stats["zero_width_pairs"] += 1
+                findings.append(Finding(
+                    "UL303", "async-pair-integrity", "warning", location,
+                    f"'{d.name}' immediately follows its start "
+                    f"'{s.name}' in computation '{comp.name}' — a "
+                    f"zero-width async window overlaps nothing (the "
+                    f"async form bought no concurrency)",
+                ))
+            if s.kind is None:
+                continue  # async copy: pair integrity only, no budget
+            stats["async_collectives"] += 1
+            stats["total_collective_bytes"] += s.bytes
+            flops, wbytes, above = _window_compute(
+                comp, s, d, min_flops=min_window_flops,
+                min_bytes=min_window_bytes,
+            )
+            stats["window_flops"] += flops
+            if above:
+                stats["overlapped_collective_bytes"] += s.bytes
+                continue
+            if not (s.is_float and has_compute):
+                continue  # int plumbing / pure-comms computation
+            if any(p.search(s.op_name) for p in wl):
+                continue
+            tail = not any(
+                ins.is_compute and (ins.flops >= min_window_flops
+                                    or ins.bytes >= min_window_bytes)
+                for ins in comp.instrs[d.index + 1:]
+            )
+            if tail:
+                continue  # nothing left to hide it behind
+            findings.append(Finding(
+                "UL301", "exposed-collective", "warning", location,
+                f"{s.kind} '{s.name}' ({s.bytes} bytes) in computation "
+                f"'{comp.name}' is exposed: its start/done window "
+                f"contains {flops} compute FLOPs (floor "
+                f"{min_window_flops}) while overlappable compute is "
+                f"scheduled after it — the collective serializes "
+                f"instead of hiding behind compute",
+            ))
+    total = stats["total_collective_bytes"]
+    stats["exposed_collective_bytes"] = (
+        total - stats["overlapped_collective_bytes"]
+    )
+    stats["overlap_ratio"] = (
+        round(stats["overlapped_collective_bytes"] / total, 6)
+        if total else None
+    )
+    return findings, stats
+
+
+def audit_compiled_schedule(compiled, *, context, **kw):
+    """Convenience wrapper over one compiled executable."""
+    return audit_schedule_text(compiled.as_text(), context=context, **kw)
+
+
+# ---------------------------------------------------------------------
+# UL302 — overlap budget (same file/fingerprint sections as UL202/UL203)
+# ---------------------------------------------------------------------
+
+def schedule_budget_keys(stats):
+    """The subset of Pass-4 stats the budget file pins."""
+    return {
+        "overlap_ratio": stats.get("overlap_ratio"),
+        "exposed_collective_bytes": stats.get(
+            "exposed_collective_bytes", 0
+        ),
+    }
+
+
+def update_schedule_budget_entries(path, fingerprint, scenario_stats):
+    """MERGE the Pass-4 keys into the fingerprint section's entries —
+    Pass 3's collective_bytes/peak_bytes for the same scenarios must
+    survive a pass4-only refresh (and vice versa)."""
+    data = load_budgets(path)
+    data.setdefault("version", 1)
+    section = data.setdefault("budgets", {}).setdefault(fingerprint, {})
+    for scenario, stats in scenario_stats.items():
+        section.setdefault(scenario, {}).update(
+            schedule_budget_keys(stats)
+        )
+    write_budgets(path, data)
+    return data
+
+
+def audit_overlap_budget(scenario, stats, entry, *,
+                         tolerance=DEFAULT_TOLERANCE):
+    """UL302: this run's overlap stats vs the committed budget for one
+    scenario.  Scenarios with no collectives at all (single-device
+    serve jits) have nothing to budget."""
+    location = f"hlo:{scenario}"
+    total = stats.get("total_collective_bytes", 0)
+    if not total:
+        return []
+    if entry is None or "exposed_collective_bytes" not in entry:
+        return [Finding(
+            "UL302", "overlap-budget", "warning", location,
+            "no committed overlap budget for this scenario under the "
+            "current environment fingerprint — run --update-budgets "
+            "and commit tools/comms_baseline.json",
+        )]
+    findings = []
+    got = stats.get("exposed_collective_bytes", 0)
+    want = entry["exposed_collective_bytes"] or 0
+    if got > want * (1.0 + tolerance):
+        pct = (f"+{(got / want - 1.0) * 100:.1f}%" if want
+               else "budgeted at zero")
+        findings.append(Finding(
+            "UL302", "overlap-budget", "error", location,
+            f"exposed collective bytes regressed: {got} vs budget "
+            f"{want} ({pct}, tolerance {tolerance * 100:.0f}%) — more "
+            f"collective traffic serializes against compute than the "
+            f"committed schedule",
+        ))
+    got_ratio = stats.get("overlap_ratio")
+    want_ratio = entry.get("overlap_ratio")
+    if (got_ratio is not None and want_ratio
+            and got_ratio < want_ratio * (1.0 - tolerance)):
+        findings.append(Finding(
+            "UL302", "overlap-budget", "error", location,
+            f"overlap ratio regressed: {got_ratio:.4f} vs budget "
+            f"{want_ratio:.4f} (tolerance {tolerance * 100:.0f}%) — "
+            f"the scheduler hides less collective traffic behind "
+            f"compute than the committed baseline",
+        ))
+    return findings

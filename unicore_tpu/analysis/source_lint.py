@@ -110,6 +110,21 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   to avoid.  Modules that never see the flag are exempt — without
   ZeRO-1 in play, replicated moments are just the normal dp layout.
 
+- UL115 unjoined-daemon-thread: a ``threading.Thread(...,
+  daemon=True)`` spawn with no reachable shutdown path — neither a
+  ``.join(...)`` on the receiver the thread was bound to anywhere in
+  the module, nor a ``stop``/``close``/``drain``/``shutdown``/
+  ``terminate``/``join`` method on the class that owns the spawn.  A
+  chained ``threading.Thread(..., daemon=True).start()`` always fires:
+  the reference is dropped on the spot, so no shutdown path can ever
+  reach it.  Daemon threads die SILENTLY at interpreter exit — an
+  async checkpoint writer's queued saves or a prefetch pump's
+  in-flight batches vanish with no error; the sanctioned worker shape
+  (``resilience/async_writer.py``, ``data/iterators.py`` pump,
+  ``resilience/watchdog.py``) always owns a stop flag or a join on the
+  shutdown path.  Non-daemon threads are exempt: they block exit
+  visibly instead of losing work.
+
 - UL110 unguarded-dataset-io: raw IO (``open``/``pickle.loads``/
   ``np.fromfile``/``np.memmap``/an LMDB ``get``) inside a dataset
   ``__getitem__``/``__iter__`` body with no enclosing ``try`` whose
@@ -240,6 +255,14 @@ _UL114_SHARDED_WRAPPERS = {"with_sharding_constraint", "device_put",
                            "make_array_from_single_device_arrays"}
 
 
+# UL115: a method with one of these names on the spawning class IS the
+# shutdown path (the watchdog's close() stops its worker with a flag +
+# wake event, never a join — the NAME marks the reachable path, the
+# flag protocol inside is the worker's business)
+_UL115_SHUTDOWN_METHODS = {"stop", "close", "drain", "shutdown",
+                           "terminate", "join"}
+
+
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
     parts = []
@@ -263,6 +286,8 @@ class _ModuleLint(ast.NodeVisitor):
         self.jnp_aliases = {"jnp"}
         self.random_aliases = set()
         self.jax_aliases = {"jax"}
+        self.threading_aliases = {"threading"}
+        self.thread_ctors = set()   # bare names: from threading import Thread
         self.jitted_names = set()
         self._with_seed_depth = 0
         self._step_loop_depth = 0
@@ -288,11 +313,19 @@ class _ModuleLint(ast.NodeVisitor):
                         self.random_aliases.add(name)
                     elif alias.name == "jax":
                         self.jax_aliases.add(name)
+                    elif alias.name == "threading":
+                        self.threading_aliases.add(name)
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "jax":
                     for alias in node.names:
                         if alias.name == "numpy":
                             self.jnp_aliases.add(
+                                alias.asname or alias.name
+                            )
+                elif node.module == "threading":
+                    for alias in node.names:
+                        if alias.name == "Thread":
+                            self.thread_ctors.add(
                                 alias.asname or alias.name
                             )
             elif isinstance(node, ast.Call) and self._is_jax_jit(node.func):
@@ -1310,6 +1343,103 @@ class _ModuleLint(ast.NodeVisitor):
         self._check_replicated_optim_init(node)
         self.generic_visit(node)
 
+    # -- UL115 ---------------------------------------------------------
+
+    def _is_thread_ctor(self, func):
+        chain = _attr_chain(func)
+        if chain is None:
+            return False
+        head, _, tail = chain.rpartition(".")
+        return ((tail == "Thread" and head in self.threading_aliases)
+                or (head == "" and tail in self.thread_ctors))
+
+    @staticmethod
+    def _spawns_daemon(call):
+        return any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+
+    def _check_daemon_threads(self):
+        """UL115 over the whole module: every ``threading.Thread(...,
+        daemon=True)`` spawn must have a reachable shutdown path — a
+        ``.join`` on the receiver it was bound to, or a shutdown-named
+        method on the owning class.  Whole-module scan rather than a
+        visitor hook: the sanction (a join in ``close()``, a ``stop``
+        method) usually lives far from the spawn."""
+        spawns = [n for n in ast.walk(self._tree)
+                  if isinstance(n, ast.Call)
+                  and self._is_thread_ctor(n.func)
+                  and self._spawns_daemon(n)]
+        if not spawns:
+            return
+        # chained `Thread(...).start()`: the reference is dropped on
+        # the spot — no shutdown path can ever reach it
+        chained = set()
+        # receivers the spawn is bound to: `self._thread = Thread(...)`
+        assigned = {}
+        # receiver tails a `.join(...)` is called on anywhere here
+        joined = set()
+        for node in ast.walk(self._tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                if (node.func.attr == "start"
+                        and isinstance(node.func.value, ast.Call)):
+                    chained.add(id(node.func.value))
+                elif node.func.attr == "join":
+                    chain = _attr_chain(node.func)
+                    if chain and "." in chain:
+                        joined.add(chain.split(".")[-2])
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        assigned[id(node.value)] = t.attr
+                    elif isinstance(t, ast.Name):
+                        assigned[id(node.value)] = t.id
+        # owning class per spawn (ast.walk is outer-first, so nested
+        # classes overwrite with the innermost owner)
+        owner_methods = {}
+        for cls in ast.walk(self._tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {n.name for n in ast.walk(cls)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            for n in ast.walk(cls):
+                if isinstance(n, ast.Call):
+                    owner_methods[id(n)] = methods
+        for call in spawns:
+            if id(call) in chained:
+                self.emit(
+                    "UL115", "unjoined-daemon-thread", "warning", call,
+                    "threading.Thread(..., daemon=True).start() drops "
+                    "the only reference to the thread — no shutdown "
+                    "path can ever join or stop it, and its in-flight "
+                    "work dies silently at interpreter exit; bind it "
+                    "and join/stop it on shutdown",
+                )
+                continue
+            recv = assigned.get(id(call))
+            if recv is None:
+                continue  # passed along, never started here: not provable
+            if recv in joined:
+                continue
+            methods = owner_methods.get(id(call), set())
+            if methods & _UL115_SHUTDOWN_METHODS:
+                continue
+            self.emit(
+                "UL115", "unjoined-daemon-thread", "warning", call,
+                f"daemon thread bound to '{recv}' has no reachable "
+                f"shutdown path — no .join() on '{recv}' in this "
+                f"module and no stop/close/drain/shutdown method on "
+                f"the owning class; a daemon worker dies silently at "
+                f"interpreter exit, losing whatever it had buffered "
+                f"(the async-writer/prefetch-pump shape owns a stop "
+                f"flag or joins on close)",
+            )
+
     def _visit_functions(self):
         for node in ast.walk(self._tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -1325,6 +1455,7 @@ class _ModuleLint(ast.NodeVisitor):
     def run(self):
         self.visit(self._tree)
         self._visit_functions()
+        self._check_daemon_threads()
         return self.findings
 
 
