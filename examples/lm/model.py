@@ -150,11 +150,15 @@ class TransformerLMModel(BaseUnicoreModel):
 
     @nn.compact
     def __call__(self, src_tokens, deterministic=True, decode=False,
-                 positions=None, paged=None, fused_head=False, **kwargs):
+                 positions=None, paged=None, fused_head=False,
+                 segment_ids=None, **kwargs):
         # decoding assumes unpadded OR right-padded prompts (generate()
         # enforces; a 2-D positions array carries the per-sequence
         # offsets); the decoder drops the key-padding mask on the decode
-        # path itself
+        # path itself.
+        # ``segment_ids`` [B, T] routes packed rows (data/packing.py)
+        # through segment-causal attention; ``positions`` then carries
+        # the per-segment reset offsets (-1 at pad slots)
         padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
         embed = nn.Embed(
             self.vocab_size,
@@ -195,7 +199,8 @@ class TransformerLMModel(BaseUnicoreModel):
             auto_regressive=True,
             name="decoder",
         )(x, padding_mask=padding_mask, deterministic=deterministic,
-          decode=decode, positions=positions, paged=paged)
+          decode=decode, positions=positions, paged=paged,
+          segment_ids=segment_ids)
 
         # tied projection + final LN'd features -> logits
         x = LayerNorm(self.decoder_embed_dim, name="out_layer_norm")(x)

@@ -15,6 +15,7 @@ from unicore_tpu.data import (
     Dictionary,
     LRUCacheDataset,
     NestedDictionaryDataset,
+    PackedTokenDataset,
     PrependTokenDataset,
     RightPadDataset,
     SortDataset,
@@ -68,6 +69,30 @@ class LMTask(UnicoreTask):
         ))
         inputs = PrependTokenDataset(tokens, self.dictionary.bos())
         targets = AppendTokenDataset(tokens, self.dictionary.eos())
+
+        if getattr(self.args, "pack_sequences", False):
+            # bin-pack variable-length samples into full [T] rows with
+            # per-segment metadata; the model routes them through
+            # segment-causal attention (requires --rel-pos False — the
+            # global-offset rel-pos bias cannot reset per segment)
+            lengths = [len(inputs[i]) for i in range(len(inputs))]
+            packed = PackedTokenDataset(
+                inputs, targets, lengths, self.args.max_seq_len,
+                self.dictionary.pad(),
+                max_segments=getattr(self.args, "pack_max_segments", 0),
+            )
+            logger.info(
+                "packed %d samples (%d tokens) into %d rows of %d "
+                "(pad waste %.1f%%)",
+                len(lengths), sum(lengths), len(packed),
+                self.args.max_seq_len,
+                100.0 * (1.0 - sum(lengths)
+                         / (len(packed) * self.args.max_seq_len)),
+            )
+            with data_utils.numpy_seed(self.args.seed):
+                shuffle = np.random.permutation(len(packed))
+            self.datasets[split] = SortDataset(packed, sort_order=[shuffle])
+            return
 
         with data_utils.numpy_seed(self.args.seed):
             shuffle = np.random.permutation(len(tokens))

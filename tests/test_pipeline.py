@@ -32,19 +32,19 @@ def _params_equal(a, b):
 
 def _run(batches, *, traj=None, **over):
     """Feed ``batches`` one group per train_step call; returns
-    (per-processing-order losses, trainer)."""
+    (per-processing-order losses, trainer).  A pipelined call can retire
+    SEVERAL steps (timing decides how many outputs the opportunistic
+    drain finds ready), so consume every returned log entry — steps
+    never returned twice, so no dedup is needed."""
     metrics.reset()
     trainer = make_trainer(trajectory_file=traj, **over)
     losses = []
     with metrics.aggregate("train"):
         for b in batches:
             out = trainer.train_step([b])
-            if out is not None:
-                losses.append(float(out[0]["loss"]))
+            losses.extend(float(o["loss"]) for o in out or ())
         out = trainer.flush_stats()
-        if out is not None and (not losses
-                                or float(out[0]["loss"]) != losses[-1]):
-            losses.append(float(out[0]["loss"]))
+        losses.extend(float(o["loss"]) for o in out or ())
         smoothed = dict(metrics.get_smoothed_values("train"))
     trainer.close()
     return losses, trainer, smoothed

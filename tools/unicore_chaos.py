@@ -44,7 +44,16 @@ Fault-injection legs (exercising the in-loop anomaly guard end to end):
                          with --inject nonfinite:K the guard's
                          where-bypass skip must leave the SHARDED
                          moments bit-untouched (every later loss
-                         matches the oracle carrying the same skip).
+                         matches the oracle carrying the same skip);
+  --comms-overlap        (requires --zero1) run BOTH runs with bucketed
+                         collective scheduling — data-sharded master
+                         params, per-bucket grad constraints, the
+                         hoisted per-bucket param gather — under a tiny
+                         bucket cap; the bucketed reduction grouping
+                         changes numerics vs non-bucketed, so the
+                         oracle shares the layout (pure function of the
+                         identical param tree + cap) and every leg must
+                         stay bit-exact against it.
 
 Serve-tier legs (``--serve``, ISSUE 7 — the same oracle discipline
 applied to the continuous-batching engine):
@@ -190,6 +199,15 @@ def train_cmd(args, data_dir, save_dir, traj_file, extra=None):
         # the full production recipe: data-axis moment sharding + bf16
         # SR moments — the kill/skip legs prove both round-trip exactly
         cmd += ["--zero1", "--optim-bf16-moments"]
+    if getattr(args, "comms_overlap", False):
+        # bucketed collective scheduling ON BOTH RUNS: bucketing changes
+        # the reduction grouping (different numerics vs non-bucketed),
+        # so the oracle must share the victim's bucket layout — which it
+        # does for free, because comm_bucket_assignment is a pure
+        # function of the (identical) param tree + cap.  The tiny cap
+        # forces multiple buckets at this toy model size (default 4 MB
+        # would collapse to one and the leg would pass vacuously).
+        cmd += ["--comms-overlap", "--comms-bucket-mb", "0.05"]
     if extra:
         cmd += list(extra)  # argparse: the LAST occurrence of a flag wins
     return cmd
@@ -1458,6 +1476,12 @@ def build_parser():
     p.add_argument("--fsdp-size", type=int, default=1,
                    help="fsdp axis of the victim runs (>1 produces the "
                         ".shard files --corrupt shard tears)")
+    p.add_argument("--comms-overlap", action="store_true",
+                   help="run BOTH runs with bucketed collective "
+                        "scheduling (--comms-overlap, tiny bucket cap); "
+                        "requires --zero1 — the bucket layout is a pure "
+                        "function of the param tree, so oracle and "
+                        "victim reduce in the same grouping")
     p.add_argument("--zero1", action="store_true",
                    help="run BOTH runs with --zero1 --optim-bf16-moments "
                         "(ZeRO-1 data-axis moment sharding + bf16 SR "
@@ -1578,6 +1602,11 @@ def main(argv=None):
             "sharding is a no-op and the leg would pass vacuously "
             "while reporting zero1:true"
         )
+    if args.comms_overlap and not args.zero1:
+        raise SystemExit(
+            "--comms-overlap requires --zero1 (same contract the trainer "
+            "enforces: the overlap schedule IS the sharded-update path)"
+        )
     workdir = args.workdir or tempfile.mkdtemp(prefix="unicore_chaos_")
     os.makedirs(workdir, exist_ok=True)
     rng = random.Random(args.seed)
@@ -1592,6 +1621,7 @@ def main(argv=None):
         "writer_fail": int(args.writer_fail),
         "pipeline_depth": int(args.pipeline_depth),
         "zero1": bool(args.zero1),
+        "comms_overlap": bool(args.comms_overlap),
     }
     # pipelined legs: the ORACLE is pinned to the strict serial loop
     # (K=1, lag 0 — the pre-pipeline semantics the ladder contract is

@@ -41,12 +41,21 @@ ZERO1_VARIANTS = (
     ("zero1", {"zero1": True, "optim_bf16_moments": True}, 2),
     ("zero1_tp2", {"zero1": True, "optim_bf16_moments": True,
                    "tensor_parallel_size": 2}, 4),
+    # bucketed collective scheduling (ISSUE 17): data-sharded master
+    # params, per-bucket zero1_grads constraints inside the backward,
+    # the hoisted per-bucket param_gather cast.  The tiny cap forces
+    # multiple buckets at audit shapes (the 4 MB default would collapse
+    # the toy tree into one and the per-bucket named scopes the UL301
+    # whitelist keys on would never appear).
+    ("zero1_overlap", {"zero1": True, "optim_bf16_moments": True,
+                       "comms_overlap": True, "comms_bucket_mb": 0.05}, 2),
 )
 
 # Pass 3 compiles (not just traces) each variant, so the set is the
 # bench-relevant subset: seq2's ring shard_map collectives are pinned by
 # tests/test_parallel.py already and its compile is the slowest.
-PASS3_VARIANTS = ("dp", "fsdp2", "tp2", "tp2_fsdp2", "zero1", "zero1_tp2")
+PASS3_VARIANTS = ("dp", "fsdp2", "tp2", "tp2_fsdp2", "zero1", "zero1_tp2",
+                  "zero1_overlap")
 
 # UL204 match pairs: (group name, [(scenario suffix, overrides,
 # micro-batches to feed), ...]) — members must compile to the same
@@ -73,6 +82,7 @@ def base_args(**overrides):
         fp16_init_scale=4.0, max_update=10, max_epoch=0,
         tensor_parallel_size=1, seq_parallel_size=1, fsdp_size=1,
         zero1=False, optim_bf16_moments=False,
+        comms_overlap=False, comms_bucket_mb=4.0,
         # the audited program is the PRODUCTION default (fused chunked
         # LM head) — with an explicit small chunk so the scan is real at
         # audit shapes (the auto heuristic would take the unfused path

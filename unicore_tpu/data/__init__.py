@@ -25,6 +25,7 @@ from .lmdb_dataset import LMDBDataset  # noqa
 from .mask_tokens_dataset import MaskTokensDataset  # noqa
 from .misc_datasets import LRUCacheDataset, NumelDataset, NumSamplesDataset  # noqa
 from .nested_dictionary_dataset import NestedDictionaryDataset  # noqa
+from .packing import PackedTokenDataset, pack_lengths  # noqa
 from .pad_dataset import (  # noqa
     LeftPadDataset,
     PadDataset,
@@ -63,6 +64,8 @@ __all__ = [
     "NestedDictionaryDataset",
     "NumelDataset",
     "NumSamplesDataset",
+    "PackedTokenDataset",
+    "pack_lengths",
     "PadDataset",
     "PrependTokenDataset",
     "RawArrayDataset",

@@ -248,7 +248,7 @@ def _leaf_spec(path, x, *, shard_axis, shard_size, tp_size):
     return dims
 
 
-def state_sharding(mesh, tree, *, zero1=False):
+def state_sharding(mesh, tree, *, zero1=False, zero1_params=False):
     """Leaf-wise NamedSharding pytree for a TrainState.
 
     Two composable rules: transformer weights shard Megatron-style over
@@ -264,7 +264,15 @@ def state_sharding(mesh, tree, *, zero1=False):
     replica stores (and updates) only its 1/N slice of the optimizer
     moments while params stay replicated (arxiv 2004.13336; the grads
     reduce-scatter and the update all-gather come from the trainer's
-    matching constraints, :func:`zero1_sharding`)."""
+    matching constraints, :func:`zero1_sharding`).
+
+    ``zero1_params``: the ``--comms-overlap`` storage layout — master
+    params and EMA shard over ``data`` exactly like the moments, so the
+    tail all-gather of updated fp32 params disappears entirely (the
+    update, the param add, and the EMA decay all run on 1/N shards) and
+    the only gather left is the step-top bf16 compute cast, which XLA
+    can overlap with the next step's early forward.  Requires
+    ``zero1``."""
     jax = _jax()
     P = jax.sharding.PartitionSpec
     extent = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -276,7 +284,7 @@ def state_sharding(mesh, tree, *, zero1=False):
         in_opt = bool(path) and str(
             getattr(path[0], "key", getattr(path[0], "name", path[0]))
         ) == "opt_state"
-        if zero1 and dp_size > 1 and in_opt:
+        if zero1 and dp_size > 1 and (in_opt or zero1_params):
             dims = _leaf_spec(path, x, shard_axis="data",
                               shard_size=dp_size, tp_size=tp_size)
         else:
@@ -311,6 +319,38 @@ def zero1_sharding(mesh, tree):
         return jax.sharding.NamedSharding(mesh, P(*dims))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def comm_bucket_assignment(tree, bucket_bytes):
+    """Deterministic leaf->bucket assignment for bucketed collectives.
+
+    One greedy sweep over the canonical ``tree_flatten_with_path`` order:
+    leaves fill bucket 0 until the next leaf would push its payload past
+    ``bucket_bytes``, then bucket 1, and so on.  A leaf larger than the
+    cap gets a bucket to itself.  Pure function of the tree structure,
+    leaf shapes/dtypes and the cap — every replica, every resume, and
+    the chaos oracle compute the identical layout, so bucketed reduction
+    order (which changes numerics vs one monolithic reduction) is still
+    bit-reproducible across runs that share the flag.
+
+    Returns ``(ids, n_buckets)`` where ``ids`` mirrors ``tree`` with an
+    int bucket id per leaf."""
+    jax = _jax()
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    ids = []
+    bucket, used = 0, 0
+    for _, x in leaves:
+        shape = getattr(x, "shape", ())
+        dtype = getattr(x, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize
+        if used and used + nbytes > bucket_bytes:
+            bucket, used = bucket + 1, 0
+        ids.append(bucket)
+        used += nbytes
+    if not leaves:
+        return tree, 0
+    return jax.tree_util.tree_unflatten(treedef, ids), bucket + 1
 
 
 def strip_axis(shardings, axis="fsdp"):

@@ -202,21 +202,41 @@ def _causal_bias(tq, tk, dtype=jnp.float32):
     return causal_iota_mask(tq, tk, dtype=dtype)[None, None]
 
 
+def _segment_bias(segment_ids, tk, dtype=jnp.float32):
+    """Additive [B, 1, T, tk] span mask for packed rows (the serve tier's
+    row-span problem, PR 13, restated for training): query q may attend
+    key k iff both live in the SAME nonzero segment (0 = pad).  Masked
+    scores get the -1e30 fill — their softmax terms underflow to exact
+    0.0, which is what makes packed per-token nll bit-equal to the padded
+    run of the same logical samples.  Composed with the causal bias:
+    segments are contiguous, so (causal AND same-segment) is exactly
+    segment-causal, with per-segment position reset handled upstream."""
+    seg_q = segment_ids[:, None, :, None]
+    seg_k = segment_ids[:, None, None, :tk]
+    ok = (seg_q == seg_k) & (seg_k != 0)
+    return jnp.where(ok, 0.0, -1e30).astype(dtype)
+
+
 def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
-            make_rng, return_attn=False, causal=False):
+            make_rng, return_attn=False, causal=False, segment_ids=None):
     """Core attention: q/k/v are [B, T, H, D].  Dispatch order: sequence
     parallelism (when the mesh's ``seq`` axis is active), then the flash
     (blockwise) Pallas kernel on TPU when eligible — the key padding mask,
     (batch-broadcast) bias, and causal masking ride into the kernel
     separately, so neither the [B, H, q, k] score matrix nor a [T, T]
     future-mask tensor is ever materialized.  The einsum + fused-softmax
-    path is the reference semantics and the fallback."""
+    path is the reference semantics and the fallback.
+
+    ``segment_ids`` [B, T] (nonzero per packed segment, 0 = pad) routes
+    through the span-masked eager path: the seq-parallel and flash
+    dispatches don't carry the segment mask yet, so packed batches take
+    the reference path unconditionally."""
     dtype = q.dtype
     rng = None
     if not deterministic and dropout > 0.0:
         rng = make_rng("dropout")
 
-    if not return_attn and q.shape[1] == k.shape[1]:
+    if segment_ids is None and not return_attn and q.shape[1] == k.shape[1]:
         sp_out = _seq_parallel_attend(
             q, k, v, scaling, dropout if not deterministic else 0.0,
             key_padding_mask, bias, causal=causal, rng=rng,
@@ -224,7 +244,7 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
         if sp_out is not None:
             return sp_out
 
-    if not return_attn and _flash_ok(
+    if segment_ids is None and not return_attn and _flash_ok(
         q, k, bias, key_padding_mask is not None, rng is not None,
         causal=causal,
     ):
@@ -237,6 +257,9 @@ def _attend(q, k, v, scaling, dropout, key_padding_mask, bias, deterministic,
         )
 
     mask = _padding_bias(key_padding_mask, dtype)
+    if segment_ids is not None:
+        sb = _segment_bias(segment_ids, k.shape[1])
+        bias = sb if bias is None else bias + sb
     if causal:
         cb = _causal_bias(q.shape[1], k.shape[1])
         bias = cb if bias is None else bias + cb
@@ -280,6 +303,7 @@ class SelfMultiheadAttention(nn.Module):
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
         paged=None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
         """``decode=True`` enables KV-cache incremental decoding (beyond
         the reference, which is a trainer only): the first call (flax
@@ -338,6 +362,12 @@ class SelfMultiheadAttention(nn.Module):
                 )
             if return_attn:
                 raise NotImplementedError("decode=True with return_attn")
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "decode=True with segment_ids (sequence packing is a "
+                    "training-path feature; decode rows are one sequence "
+                    "each by construction)"
+                )
             if positions is None and self.rotary and not self.is_initializing():
                 raise ValueError(
                     "decode=True with rotary requires positions= (the "
@@ -364,7 +394,7 @@ class SelfMultiheadAttention(nn.Module):
         out = _attend(
             q, k, v, scaling, self.dropout, key_padding_mask, bias,
             deterministic, self.make_rng, return_attn=return_attn,
-            causal=causal,
+            causal=causal, segment_ids=segment_ids,
         )
         if return_attn:
             o, attn_weights, probs = out

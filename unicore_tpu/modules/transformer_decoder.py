@@ -59,6 +59,7 @@ class TransformerDecoderLayer(nn.Module):
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
         paged=None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
         act = get_activation_fn(self.activation_fn)
 
@@ -80,7 +81,7 @@ class TransformerDecoderLayer(nn.Module):
         )(x, key_padding_mask=None if decode else padding_mask,
           attn_bias=attn_bias,
           deterministic=deterministic, causal=causal, decode=decode,
-          positions=positions, paged=paged)
+          positions=positions, paged=paged, segment_ids=segment_ids)
         x = drop(x, self.dropout)
         x = residual + x
         if self.post_ln:
@@ -153,7 +154,20 @@ class TransformerDecoder(nn.Module):
         decode: bool = False,
         positions: Optional[jnp.ndarray] = None,
         paged=None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
+        if segment_ids is not None and self.rel_pos:
+            # the shared [T, T] relative-position bias is indexed by
+            # GLOBAL row offsets — across a segment boundary it would
+            # claim tokens of different samples are "close"; packing
+            # needs position schemes that reset per segment (rotary or
+            # absolute positions driven by the packed `positions` array)
+            raise NotImplementedError(
+                "sequence packing (segment_ids) with rel_pos=True: the "
+                "relative-position bias is global-offset-indexed and "
+                "cannot reset per segment — build the decoder with "
+                "rel_pos=False (rotary or absolute positions)"
+            )
         if decode and self.rel_pos:
             raise NotImplementedError(
                 "incremental decoding needs a position scheme that does "
@@ -211,7 +225,7 @@ class TransformerDecoder(nn.Module):
                 name=f"layers_{i}",
             )(x, encoder_out, attn_mask, padding_mask, encoder_attn_mask,
               encoder_padding_mask, deterministic, self.auto_regressive,
-              decode, positions, paged=paged)
+              decode, positions, paged=paged, segment_ids=segment_ids)
 
         if not self.post_ln:
             x = LayerNorm(self.embed_dim, name="final_layer_norm")(x)

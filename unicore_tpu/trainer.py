@@ -204,6 +204,25 @@ class Trainer:
             self.zero1 and self._mesh_shape.get("data", 1) > 1
         )
 
+        # Bucketed overlapped collectives (--comms-overlap, arxiv
+        # 2011.03641): master params + EMA store data-sharded like the
+        # zero1 moments, grads reduce-scatter per deterministic bucket
+        # (distributed.utils.comm_bucket_assignment) as the backward
+        # produces them, and the one remaining gather — the step-top
+        # bf16 compute cast — sits where XLA's async scheduler can
+        # hide it behind early-forward compute.
+        self.comms_overlap = bool(getattr(args, "comms_overlap", False))
+        if self.comms_overlap and not self.zero1:
+            raise ValueError(
+                "--comms-overlap requires --zero1 (it restructures the "
+                "ZeRO-1 weight-update collectives; fsdp schedules its "
+                "own gathers)"
+            )
+        self._comms_overlap_active = self.comms_overlap and self._zero1_active
+        self._comms_bucket_bytes = int(
+            float(getattr(args, "comms_bucket_mb", 4.0) or 4.0) * (1 << 20)
+        )
+
         # activate sequence parallelism for this run's mesh: attention
         # modules consult the context at trace time and dispatch to
         # ring/Ulysses over the ``seq`` axis
@@ -469,7 +488,8 @@ class Trainer:
         init."""
         abstract = jax.eval_shape(self.optimizer.init, params)
         shardings = state_sharding(
-            self.mesh, {"opt_state": abstract}, zero1=self._zero1_active
+            self.mesh, {"opt_state": abstract}, zero1=self._zero1_active,
+            zero1_params=self._comms_overlap_active,
         )["opt_state"]
         return jax.jit(self.optimizer.init, out_shardings=shardings)(params)
 
@@ -486,7 +506,8 @@ class Trainer:
         without ever assembling the full array on any host."""
         state = _map_host_arrays(jnp.asarray, state)
         self._state_shardings = state_sharding(
-            self.mesh, state, zero1=self._zero1_active
+            self.mesh, state, zero1=self._zero1_active,
+            zero1_params=self._comms_overlap_active,
         )
         # ZeRO-1 update layout: the step constrains the accumulated
         # grads to this param-structured data-sharded spec (emitting the
@@ -504,6 +525,17 @@ class Trainer:
 
             self._compute_param_shardings = strip_axis(
                 self._state_shardings["params"]
+            )
+        elif self._comms_overlap_active:
+            # overlap storage layout: master params are data-sharded, so
+            # the compute cast strips the data axis — THE param gather
+            # of the step, on bf16 bytes (half the fp32 tail gather it
+            # replaces), issued per bucket at the step top where it can
+            # overlap the next step's early forward on an async backend
+            from unicore_tpu.distributed.utils import strip_axis
+
+            self._compute_param_shardings = strip_axis(
+                self._state_shardings["params"], axis="data"
             )
         elif self._zero1_active:
             # pin the compute-dtype cast to the stored (replicated /
@@ -527,6 +559,54 @@ class Trainer:
         self._pending_loaded_entries = None
         self._all_shard_entries_cache = None
         self._peer_entries_cache = {}
+        # --comms-overlap bucket layout: computed from the LIVE param
+        # tree (shapes + dtypes), a pure function of tree + cap, so the
+        # serial oracle, every replica, and every resume agree on it
+        if self._comms_overlap_active:
+            from unicore_tpu.distributed.utils import comm_bucket_assignment
+
+            self._comm_bucket_ids, self._comm_bucket_count = (
+                comm_bucket_assignment(
+                    self.state["params"], self._comms_bucket_bytes
+                )
+            )
+            logger.info(
+                "comms-overlap: %d param leaves -> %d buckets (cap %.1f MB)",
+                len(jax.tree_util.tree_leaves(self._comm_bucket_ids)),
+                self._comm_bucket_count,
+                self._comms_bucket_bytes / (1 << 20),
+            )
+        else:
+            self._comm_bucket_ids, self._comm_bucket_count = None, 0
+
+    def _bucketed_constraint(self, tree, shardings, name):
+        """Sharding constraint issued per comm bucket under a named scope.
+
+        Under ``--comms-overlap`` the leaves of ``tree`` (param-structured)
+        are constrained bucket-by-bucket, each bucket inside
+        ``jax.named_scope(f"{name}_bucket{b}")`` — XLA sees one collective
+        per bucket it is free to schedule as that bucket's operands land,
+        and the scope names land in the op metadata where Pass-4's UL301
+        whitelist (``zero1`` / ``param_gather``) certifies them as
+        intentionally-tail traffic.  Without overlap this is exactly the
+        classic single ``with_sharding_constraint``."""
+        if not self._comms_overlap_active or self._comm_bucket_ids is None:
+            return jax.lax.with_sharding_constraint(tree, shardings)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shard_leaves = jax.tree_util.tree_leaves(shardings)
+        id_leaves = jax.tree_util.tree_leaves(self._comm_bucket_ids)
+        out = list(leaves)
+        for b in range(self._comm_bucket_count):
+            idx = [i for i, bid in enumerate(id_leaves) if bid == b]
+            if not idx:
+                continue
+            with jax.named_scope(f"{name}_bucket{b:03d}"):
+                sub = jax.lax.with_sharding_constraint(
+                    [out[i] for i in idx], [shard_leaves[i] for i in idx]
+                )
+            for i, v in zip(idx, sub):
+                out[i] = v
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def _peer_shard_entries(self, process):
         """Shard entries from peer ``process``'s file, cached per file and
@@ -723,14 +803,23 @@ class Trainer:
     # the compiled steps
     # ------------------------------------------------------------------
 
-    def _loss_for_microbatch(self, params_f32, batch, rng, weight, scale):
+    def _loss_for_microbatch(self, params_f32, batch, rng, weight, scale,
+                             precast=False):
         """Scaled, weighted micro-batch loss; returns aux for logging.
 
         The master->compute cast applies stochastic rounding under
         ``--bf16-sr`` (straight-through gradient; the functional analogue
         of the reference's post-step SR sync, fp16_optimizer.py:146-148,
-        with a per-microbatch rng instead of a fixed post-step seed)."""
-        if self.bf16_sr and self.compute_dtype == jnp.bfloat16:
+        with a per-microbatch rng instead of a fixed post-step seed).
+
+        ``precast``: the params arrived already cast + gather-constrained
+        (the --comms-overlap step hoists one cast to the step top so the
+        gather can overlap; under --bf16-sr that means ONE stochastic
+        draw per step instead of per micro-batch — a documented semantic
+        change gated behind the flag)."""
+        if precast:
+            params = params_f32
+        elif self.bf16_sr and self.compute_dtype == jnp.bfloat16:
             params = sync_master_to_model(
                 params_f32, self.compute_dtype,
                 sr_rng=jax.random.fold_in(rng, 0x5F1C),
@@ -739,7 +828,9 @@ class Trainer:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype), params_f32
             )
-        if getattr(self, "_compute_param_shardings", None) is not None:
+        if (not precast
+                and getattr(self, "_compute_param_shardings", None)
+                is not None):
             # fsdp: gather the compute copy once here so the whole
             # forward/backward runs the clean batch-sharded program
             # (storage stays ZeRO-sharded; grads reduce-scatter at the
@@ -772,6 +863,10 @@ class Trainer:
         zero1_shardings = self._zero1_shardings
         grad_shardings = (zero1_shardings if zero1_shardings is not None
                           else state_shardings["params"])
+        overlap = self._comms_overlap_active
+        bucketed = self._bucketed_constraint
+        compute_dtype = self.compute_dtype
+        bf16_sr = self.bf16_sr
         wants_opt_rng = bool(optimizer.wants_update_rng)
         guard_cfg = self._guard_cfg
         chaos_inject = self._chaos_inject
@@ -788,6 +883,39 @@ class Trainer:
 
         def train_step(state, batches, weights, lr, rng, inject):
             scale = state["scaler"]["scale"] if use_scaler else jnp.float32(1.0)
+
+            if overlap:
+                # --comms-overlap: ONE master->compute cast at the step
+                # top, gather-constrained per bucket under param_gather_*
+                # scopes.  This is the step's only param gather — on
+                # compute-dtype bytes (half the fp32 tail gather the
+                # default zero1 program pays) and positioned where an
+                # async backend can hide it behind the previous step's
+                # tail / this step's early forward.  Differentiating wrt
+                # the gathered copy keeps grad values bit-identical to
+                # the cast-inside form: the cast adjoint is an exact
+                # bf16->fp32 convert either way.
+                if bf16_sr and compute_dtype == jnp.bfloat16:
+                    diff_params = sync_master_to_model(
+                        state["params"], compute_dtype,
+                        sr_rng=jax.random.fold_in(rng, 0x5F1C),
+                    )
+                else:
+                    diff_params = jax.tree_util.tree_map(
+                        lambda p: p.astype(compute_dtype), state["params"]
+                    )
+                diff_params = bucketed(
+                    diff_params, self._compute_param_shardings,
+                    "param_gather",
+                )
+
+                def loss_fn(p, b, r, w, s):
+                    return self._loss_for_microbatch(
+                        p, b, r, w, s, precast=True
+                    )
+            else:
+                diff_params = state["params"]
+                loss_fn = self._loss_for_microbatch
 
             def grads_per_sample_clipped(batch, mb_rng, w):
                 """Per-EXAMPLE gradients, each clipped to psc, then summed.
@@ -807,8 +935,8 @@ class Trainer:
                     # would draw the identical dropout mask
                     ex_rng = jax.random.fold_in(mb_rng, ex_idx)
                     (l_e, (ss_e, logs_e)), g = jax.value_and_grad(
-                        self._loss_for_microbatch, has_aux=True
-                    )(state["params"], ex, ex_rng, w, scale)
+                        loss_fn, has_aux=True
+                    )(diff_params, ex, ex_rng, w, scale)
                     # clip threshold applies to the UNSCALED grad norm
                     gn = utils.global_norm(g) / scale
                     coef = jnp.minimum(1.0, psc / (gn + 1e-6))
@@ -846,8 +974,8 @@ class Trainer:
                     )
                 else:
                     (lsum, (ss, logs)), grads = jax.value_and_grad(
-                        self._loss_for_microbatch, has_aux=True
-                    )(state["params"], batch, mb_rng, w, scale)
+                        loss_fn, has_aux=True
+                    )(diff_params, batch, mb_rng, w, scale)
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
                 )
@@ -859,10 +987,13 @@ class Trainer:
                 # Under --zero1 the accumulator is instead pinned to the
                 # data-sharded update layout: each micro-batch's partial
                 # grads reduce-scatter into a 1/N-sized carry (grad
-                # memory /N and all-reduce bytes halved per micro)
-                grads_acc = jax.lax.with_sharding_constraint(
-                    grads_acc, grad_shardings
-                )
+                # memory /N and all-reduce bytes halved per micro).
+                # Under --comms-overlap the constraint is issued per
+                # bucket (zero1_grads_bucket* scopes) so each bucket's
+                # reduce-scatter can fire as its cotangents land instead
+                # of waiting for the whole backward
+                grads_acc = bucketed(grads_acc, grad_shardings,
+                                     "zero1_grads")
                 if sum_logs:
                     logs_acc = jax.tree_util.tree_map(
                         lambda a, l: a + l, logs_acc, logs
@@ -921,7 +1052,7 @@ class Trainer:
             # axis, or the data axis under --zero1) so XLA emits a
             # reduce-scatter (not all-reduce) and the optimizer update
             # runs on each device's param shard only
-            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            grads = bucketed(grads, grad_shardings, "zero1_grads")
 
             grad_norm = utils.global_norm(grads)
             if clip_norm > 0:
@@ -1033,6 +1164,12 @@ class Trainer:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype), source
             )
+            if getattr(self, "_compute_param_shardings", None) is not None:
+                # gather ZeRO-stored (fsdp / --comms-overlap) params once
+                # so eval runs the clean batch-sharded program
+                params = jax.lax.with_sharding_constraint(
+                    params, self._compute_param_shardings
+                )
             loss, sample_size, logging_output = self.task.loss_and_metrics(
                 self.model, self.loss, params, batch, rng, is_training=False
             )
@@ -1072,9 +1209,10 @@ class Trainer:
         (a list of raw micro-batches, or a :class:`StagedBatch` from
         :meth:`stage_batches`).
 
-        With ``stats_lag > 0`` the returned logging outputs are those of
-        the step dispatched ``stats_lag`` calls ago (None while the
-        pipeline fills); callers that need exact counts/meters (stop
+        With ``stats_lag > 0`` or ``pipeline_depth >= 2`` the returned
+        logging outputs are those of every step RETIRED during this call
+        (possibly several, concatenated in dispatch order; None while
+        the pipeline fills); callers that need exact counts/meters (stop
         checks, checkpoint, validation) call :meth:`flush_stats` first.
         At ``--pipeline-depth K >= 2`` the in-flight ring replaces the
         stats-lag drain: see :meth:`_pipelined_step`.
@@ -1086,10 +1224,10 @@ class Trainer:
         if self.pipeline_depth > 1:
             return self._pipelined_step(staged)
         self._dispatch_staged(staged)
-        out = None
+        out = []
         while len(self._pending_stats) > self.stats_lag:
-            out = self._pop_process()
-        return out
+            out.extend(self._pop_process() or ())
+        return out or None
 
     def _dispatch_staged(self, staged, hold_batch=False):
         """Dispatch one staged micro-batch group through the compiled
@@ -1267,18 +1405,25 @@ class Trainer:
         staged buffers, same dispatch ids — within this call."""
         queue = self._replay_queue
         queue.append(staged)
-        out = None
+        # ACCUMULATE every drained step's logging outputs, in dispatch
+        # order: how many steps retire inside one call is timing-
+        # dependent (the opportunistic is_ready drains), so returning
+        # only the newest step's logs silently dropped the others from
+        # the caller's view whenever two drained together — the losses a
+        # caller collects per call then differed run-to-run even though
+        # the trajectory itself is bit-exact
+        out = []
         while queue:
             # free a slot: block on the oldest step (its watchdog-armed
             # device_get is the drain point; the device still holds the
             # other K-1 queued steps, so this wait cannot starve it)
             while len(self._pending_stats) >= self.pipeline_depth:
                 got = self._pop_process()
-                out = got if got is not None else out
+                out.extend(got or ())
             sync_snapshot = False
             if self._snapshot_window_hit():
                 got = self._drain_all()
-                out = got if got is not None else out
+                out.extend(got or ())
                 iv = self._snapshot_interval
                 sync_snapshot = (self.get_num_updates() + 1) % iv == 0
             self._dispatch_staged(queue.pop(0), hold_batch=True)
@@ -1287,13 +1432,13 @@ class Trainer:
                 # captures exactly the post-interval-update state (one
                 # pipeline bubble per snapshot interval)
                 got = self._drain_all()
-                out = got if got is not None else out
+                out.extend(got or ())
             else:
                 while (self._pending_stats
                        and self._stats_ready(self._pending_stats[0][0])):
                     got = self._pop_process()
-                    out = got if got is not None else out
-        return out
+                    out.extend(got or ())
+        return out or None
 
     def trace_train_step(self, samples):
         """AOT trace + lower the jitted train step WITHOUT executing it.
@@ -1446,25 +1591,26 @@ class Trainer:
         validation, epoch boundary) always leaves every pulled group
         dispatched and processed: the checkpoint's dispatch_count and
         the iterator position stay aligned."""
-        out = None
+        out = []
         while self._pending_stats or self._replay_queue:
             if not self._pending_stats:
                 self._dispatch_staged(self._replay_queue.pop(0),
                                       hold_batch=True)
                 continue
             got = self._pop_process()
-            out = got if got is not None else out
-        return out
+            out.extend(got or ())
+        return out or None
 
     def _drain_all(self):
         """Process every in-flight ring entry, oldest first; rewind
         replays spawned mid-drain ride ``_replay_queue`` for the
-        caller.  Returns the last processed step's logging outputs."""
-        out = None
+        caller.  Returns the concatenated logging outputs of every
+        processed step, in dispatch order."""
+        out = []
         while self._pending_stats:
             got = self._pop_process()
-            out = got if got is not None else out
-        return out
+            out.extend(got or ())
+        return out or None
 
     def num_pending_updates(self):
         """Dispatched-but-unprocessed steps (optimistic update count =
