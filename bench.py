@@ -720,6 +720,154 @@ def _fleet_failover_micros(out):
     return round(recovery_ms, 2)
 
 
+def _deploy_micros(out):
+    """Train-to-serve deployment cost (ISSUE 18), three numbers:
+
+    - ``publish_swap_stall_ms``: host stall of ONE in-place weight
+      hot-swap on a warm engine with decodes in flight (median of 5) —
+      the per-replica price of a live publish landing.
+    - ``canary_promote_ms``: wall duration of a full canary-gated
+      rollout under the COMMITTED trace (``FLEET_TRACE_SEED``): from
+      the router step that picks the manifest up to the step that
+      promotes it fleet-wide, canary window included.
+    - ``publish_ttft_p99_delta_ms``: p99 TTFT of that publish-disturbed
+      replay minus the undisturbed replay of the same trace — what the
+      rollout costs the latency tail (zero-downtime means this should
+      be noise, not a regime change).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from unicore_tpu.checkpoint_utils import atomic_save
+    from unicore_tpu.deploy import DeploySubscriber, RolloutController, \
+        WeightPublisher
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import generate_trace
+    from unicore_tpu.serve.scheduler import Request
+
+    def warm_fleet():
+        engines = {}
+        for rid in ("r0", "r1"):
+            _, engines[rid] = _serve_engine(max_waiting=16)
+        for eng in engines.values():
+            eng.generate([
+                Request(prompt=list(range(1, n + 1)), max_new_tokens=2,
+                        seed=0)
+                for n in (8, 16, 32, 64)
+            ])
+            eng.collect_finished()
+        return engines
+
+    def replay(router, trace, hook=None):
+        pending = sorted(trace,
+                         key=lambda e: (e.at_ms, e.request.request_id))
+        now, steps, i = 0.0, 0, 0
+        while i < len(pending) or router.has_work():
+            while i < len(pending) and pending[i].at_ms <= now:
+                ev = pending[i]
+                router.submit(ev.request, session_key=ev.session)
+                i += 1
+            if i < len(pending) and not router.has_work():
+                now = max(now, pending[i].at_ms)
+                continue
+            if hook is not None:
+                hook(router, steps)  # the hook owns this step's step()
+            else:
+                router.step()
+            now += 2.0
+            steps += 1
+            assert steps < 200000, "deploy bench wedged"
+        router.collect()
+        ttfts = sorted(r.ttft_ms for r in router.results().values()
+                       if r.ttft_ms is not None)
+        return float(np.percentile(ttfts, 99))
+
+    trace = generate_trace(
+        FLEET_TRACE_SEED, num_requests=64, sessions=8,
+        vocab=4096, body_len_clip=(1, 48), max_new_tokens=(4, 12),
+    )
+
+    # 1) swap stall: warm engine, 8 long decodes IN FLIGHT, 5 swaps
+    # between serve steps (each installs a fresh device copy — the
+    # engine donates the previous swap's buffers, so reuse would feed
+    # it deleted arrays)
+    model, eng = _serve_engine(max_waiting=16)
+    srng = np.random.RandomState(3)
+    eng.generate([Request(prompt=srng.randint(
+        1, model.vocab_size, size=(32,)).tolist(),
+        max_new_tokens=2, seed=0)])
+    host = jax.device_get(eng.params)
+    eng.submit([Request(prompt=srng.randint(
+        1, model.vocab_size, size=(32,)).tolist(),
+        max_new_tokens=96, seed=i) for i in range(8)])
+    eng.serve_step()
+
+    def one_swap():
+        stall = eng.swap_weights(jax.device_put(host)) * 1e3
+        eng.serve_step()
+        return stall
+
+    stalls = [one_swap() for _ in range(5)]
+    assert eng.weight_swaps == 5 and eng.has_work(), (
+        "swap-stall micro lost its in-flight work")
+    while eng.has_work():
+        eng.serve_step()
+    eng.collect_finished()
+
+    # 2) undisturbed baseline replay of the committed trace
+    base_p99 = replay(FleetRouter(warm_fleet()), trace)
+
+    # 3) publish-disturbed replay: a verified manifest lands at step 4,
+    # the controller canaries r0 off-ring and promotes one replica per
+    # step; the rollout's wall time is the sum of the step durations
+    # from manifest pickup to fleet-wide promote
+    workdir = tempfile.mkdtemp(prefix="bench_deploy_")
+    try:
+        ckpt = os.path.join(workdir, "checkpoint_pub.pt")
+        atomic_save({"model": {"params": host}, "args": None}, ckpt)
+        publisher = WeightPublisher(os.path.join(workdir, "publish"))
+        router = FleetRouter(warm_fleet())
+        ctl = RolloutController(
+            router, DeploySubscriber(publisher.publish_dir),
+            canary_steps=12, divert_period=4,
+        )
+        timing = {"rollout_ms": 0.0, "done": False}
+
+        def hook(rt, step):
+            if step == 4:
+                publisher.publish(ckpt, source_step=1)
+            t0 = time.perf_counter()
+            rt.step()
+            dt = time.perf_counter() - t0
+            if not timing["done"]:
+                if ctl.state != "idle" or ctl.stats["promotes"] > 0:
+                    timing["rollout_ms"] += dt * 1e3
+                if ctl.stats["promotes"] > 0:
+                    timing["done"] = True
+
+        pub_p99 = replay(router, trace, hook=hook)
+        assert ctl.stats["promotes"] == 1 and not ctl.quarantined, (
+            f"deploy bench rollout did not promote: {ctl.describe()}")
+        assert ctl.stats["swaps"] == 2, ctl.stats
+        res = router.results()  # trace results + the canary probe's
+        assert all(e.request.request_id in res for e in trace), (
+            "publish replay dropped requests")
+        assert all(e.pool.is_idle() for e in router.engines.values())
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    out["canary_promote_ms"] = round(timing["rollout_ms"], 2)
+    out["publish_ttft_p99_delta_ms"] = round(pub_p99 - base_p99, 2)
+    out["publish_baseline_ttft_p99_ms"] = round(base_p99, 2)
+    out["publish_canary_steps"] = 12
+    out["publish_diverted"] = ctl.stats["diverted"]
+    out["publish_trace_seed"] = FLEET_TRACE_SEED
+    return round(sorted(stalls)[2], 2)
+
+
 def _host_overlap_micros(out):
     """Step-boundary host time + checkpoint save stall, async vs sync
     (ISSUE 6), on the shrunk 2x64 trainer — the numbers isolate the
@@ -1657,6 +1805,11 @@ def _microbench(out):
     _micro_guard(out, "fleet_failover_recovery_ms",
                  lambda: _fleet_failover_micros(out))
 
+    # train-to-serve deployment (ISSUE 18): hot-swap stall, canary
+    # rollout wall time, and the publish-induced TTFT tail delta
+    _micro_guard(out, "publish_swap_stall_ms",
+                 lambda: _deploy_micros(out))
+
     # step-boundary overlap (ISSUE 6): top-level helper, shared with
     # the BENCH_CPU_TIER entry point
     _micro_guard(out, "step_boundary_host_ms",
@@ -1788,6 +1941,7 @@ def _cpu_tier_main():
         ("fleet_shed_rate", lambda: _fleet_slo_micros(micro)),
         ("fleet_failover_recovery_ms",
          lambda: _fleet_failover_micros(micro)),
+        ("publish_swap_stall_ms", lambda: _deploy_micros(micro)),
         ("serve_decode_tokens_per_sec", lambda: _serve_micros(micro)),
         ("serve_warm_prefix_ttft_ms",
          lambda: _serve_ragged_micros(micro)),

@@ -2348,3 +2348,111 @@ def test_ul115_repo_sweep_clean():
         if f.rule == "UL115"
     ]
     assert found == [], "\n".join(f.render() for f in found)
+
+
+# ---------------------------------------------------------------------
+# UL116 unverified-checkpoint-read
+# ---------------------------------------------------------------------
+
+def _lint_deploy_snippet(tmp_path, code, name="sub.py"):
+    """Write the snippet under a deploy/ dir so the UL116 path
+    predicate (deploy/serve/fleet code) marks it in scope."""
+    d = tmp_path / "deploy"
+    d.mkdir(exist_ok=True)
+    f = d / name
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([str(f)])
+
+
+def test_ul116_fires_on_raw_checkpoint_reads(tmp_path):
+    # open(manifest_path), pickle.loads(ckpt_bytes), and both halves of
+    # pickle.load(open("checkpoint_last.pt")) are raw checkpoint reads
+    # with neither read_verified nor a typed re-raise around them
+    found = _lint_deploy_snippet(tmp_path, """
+        import pickle
+        def read_manifest(manifest_path):
+            with open(manifest_path, "rb") as fh:
+                return pickle.loads(fh.read())
+        def from_bytes(ckpt_bytes):
+            return pickle.loads(ckpt_bytes)
+        def from_literal():
+            return pickle.load(open("checkpoint_last.pt", "rb"))
+    """)
+    ul116 = [f for f in found if f.rule == "UL116"]
+    assert len(ul116) >= 3, found
+
+
+def test_ul116_silent_on_read_verified_and_typed_reraise(tmp_path):
+    # the two sanctioned shapes — bytes straight out of read_verified,
+    # or a try whose handler re-raises typed — plus a read that never
+    # names checkpoint bytes at all
+    found = _lint_deploy_snippet(tmp_path, """
+        import pickle
+        from unicore_tpu.checkpoint_utils import (CheckpointIntegrityError,
+                                                  read_verified)
+        def read_manifest(manifest_path):
+            return pickle.loads(read_verified(manifest_path))
+        def read_guarded(ckpt_path):
+            try:
+                with open(ckpt_path, "rb") as fh:
+                    return pickle.loads(fh.read())
+            except OSError as e:
+                raise CheckpointIntegrityError(str(e)) from e
+        def read_prompts(prompts_path):
+            with open(prompts_path) as fh:
+                return fh.read()
+    """)
+    assert "UL116" not in rules_of(found)
+
+
+def test_ul116_try_does_not_guard_nested_def(tmp_path):
+    # a function DEFINED inside a re-raising try executes later,
+    # outside the guard — its raw read still fires
+    found = _lint_deploy_snippet(tmp_path, """
+        import pickle
+        def make_loader(manifest_path):
+            try:
+                def load():
+                    return pickle.load(open(manifest_path, "rb"))
+            except Exception as e:
+                raise RuntimeError("never guards load()") from e
+            return load
+    """)
+    assert "UL116" in rules_of(found)
+
+
+def test_ul116_ignores_train_side_files(tmp_path):
+    found = _lint_snippet(tmp_path, "train_utils.py", """
+        import pickle
+        def peek(ckpt_path):
+            return pickle.load(open(ckpt_path, "rb"))
+    """)
+    assert "UL116" not in rules_of(found)
+
+
+def test_ul116_inline_suppression(tmp_path):
+    found = _lint_deploy_snippet(tmp_path, """
+        import pickle
+        def peek(ckpt_path):
+            return pickle.load(open(ckpt_path, "rb"))  # unicore-lint: disable=UL116
+    """)
+    assert "UL116" not in rules_of(found)
+
+
+def test_ul116_repo_sweep_clean():
+    """Every checkpoint/manifest read in deploy/serve/fleet code goes
+    through read_verified (deploy/loader.py, deploy/publish.py) or a
+    typed re-raise."""
+    import os
+
+    root = _repo_root()
+    found = [
+        f for f in lint_paths(
+            [os.path.join(root, "unicore_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "tools")],
+            rel_to=root,
+        )
+        if f.rule == "UL116"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)

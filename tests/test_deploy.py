@@ -1,0 +1,407 @@
+"""Deploy tier (unicore_tpu/deploy): train-to-serve continuous
+deployment — verified manifest publish, zero-downtime hot-swap, and
+canary-gated rollout.
+
+The load-bearing properties:
+
+- a manifest inherits the checkpoint integrity ladder (marker-last
+  atomic write, torn-write discrimination, monotonic publish ids);
+- ``swap_weights`` installs new params BETWEEN serve steps without
+  touching the paged-KV pool, page tables, or in-flight sequences —
+  a same-weights swap mid-generation is bit-invisible;
+- the rollout state machine promotes only through a gated canary, and
+  a poisoned or torn publish never reaches a second replica."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from examples.lm.model import TransformerLMModel
+from unicore_tpu.checkpoint_utils import (CheckpointIntegrityError,
+                                          atomic_save, file_integrity)
+from unicore_tpu.deploy import (DeployError, DeploySubscriber,
+                                RolloutController, WeightPublisher,
+                                load_manifest_params, manifest_name,
+                                read_manifest, scan_publish_dir)
+from unicore_tpu.fleet import FleetRouter, clip_trace, generate_trace, \
+    replay_trace
+from unicore_tpu.serve.engine import ServeEngine, WeightSwapError
+from unicore_tpu.serve.scheduler import Request
+
+V, PAD = 29, 0
+POOL = dict(num_pages=24, page_size=4, max_batch=4)
+MAX_CONTEXT = (POOL["num_pages"] - 1) * POOL["page_size"]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLMModel(
+        vocab_size=V, padding_idx=PAD, decoder_layers=2,
+        decoder_embed_dim=32, decoder_ffn_embed_dim=64,
+        decoder_attention_heads=4, max_seq_len=64,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, rel_pos=False, abs_pos=False, rotary=True,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def save_checkpoint_for(params, path, *, poison=False):
+    host = jax.device_get(params)
+    if poison:
+        host = jax.tree_util.tree_map(
+            lambda x: np.full_like(np.asarray(x), np.nan), host)
+    atomic_save({"model": {"params": host}, "args": None}, path)
+    return path
+
+
+def solo_tokens(lm, req):
+    model, params = lm
+    engine = ServeEngine(model, params, num_pages=64, page_size=4,
+                         max_batch=1)
+    [res] = engine.generate([dataclasses.replace(req)])
+    return res.tokens
+
+
+# -- publisher: manifest atomicity, versioning, torn discrimination ---------
+
+
+def test_publish_writes_versioned_verified_manifest(lm, tmp_path):
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub = WeightPublisher(str(tmp_path / "publish"))
+    m = pub.publish(ckpt, source_step=7)
+    assert m.publish_id == 1 and m.source_step == 7
+    assert m.checkpoint == os.path.abspath(ckpt)
+    assert os.path.basename(ckpt) in m.sha256
+    # marker-last atomic write: the manifest verifies like a checkpoint
+    path = tmp_path / "publish" / manifest_name(1)
+    assert file_integrity(str(path)) == "ok"
+    again = read_manifest(str(path))
+    assert again == m
+
+
+def test_publish_ids_are_monotonic_and_recovered(lm, tmp_path):
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub = WeightPublisher(str(tmp_path / "publish"))
+    assert pub.publish(ckpt).publish_id == 1
+    assert pub.publish(ckpt).publish_id == 2
+    # a fresh publisher (post-restart) continues the sequence from disk
+    pub2 = WeightPublisher(str(tmp_path / "publish"))
+    assert pub2.publish(ckpt).publish_id == 3
+
+
+def test_publish_refuses_torn_checkpoint(lm, tmp_path):
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    with open(ckpt, "r+b") as fh:
+        fh.write(b"torn!")
+    pub = WeightPublisher(str(tmp_path / "publish"))
+    with pytest.raises(CheckpointIntegrityError):
+        pub.publish(ckpt)
+    assert scan_publish_dir(str(tmp_path / "publish")) == {}
+
+
+def test_torn_manifest_discriminated_and_skipped(lm, tmp_path):
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub_dir = str(tmp_path / "publish")
+    pub = WeightPublisher(pub_dir)
+    pub.publish(ckpt)
+    m2 = pub.publish(ckpt)
+    # tear the NEWER manifest after its marker landed
+    with open(os.path.join(pub_dir, manifest_name(m2.publish_id)),
+              "r+b") as fh:
+        fh.write(b"torn!")
+    states = {pid: st for pid, (_, st) in scan_publish_dir(pub_dir).items()}
+    assert states == {1: "ok", 2: "torn"}
+    with pytest.raises(CheckpointIntegrityError):
+        read_manifest(os.path.join(pub_dir, manifest_name(2)))
+    sub = DeploySubscriber(pub_dir)
+    m = sub.poll()
+    assert m is not None and m.publish_id == 1
+    torn = sub.take_torn()
+    assert [pid for pid, _ in torn] == [2]
+    assert sub.take_torn() == []  # reported once, not every poll
+
+
+def test_unverified_manifest_held_until_marker_lands(lm, tmp_path):
+    """A manifest whose .sum has not landed yet is an IN-FLIGHT write:
+    the subscriber must neither surface nor condemn it."""
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub_dir = str(tmp_path / "publish")
+    pub = WeightPublisher(pub_dir)
+    pub.publish(ckpt)
+    path = os.path.join(pub_dir, manifest_name(1))
+    os.rename(path + ".sum", path + ".sum.hold")
+    sub = DeploySubscriber(pub_dir)
+    assert sub.poll() is None
+    assert sub.take_torn() == []
+    os.rename(path + ".sum.hold", path + ".sum")
+    m = sub.poll()
+    assert m is not None and m.publish_id == 1
+
+
+def test_subscriber_is_deterministic_and_rate_limited(lm, tmp_path):
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub_dir = str(tmp_path / "publish")
+    pub = WeightPublisher(pub_dir)
+    pub.publish(ckpt)
+    pub.publish(ckpt)
+    # two independent subscribers surface the SAME newest manifest
+    a, b = DeploySubscriber(pub_dir), DeploySubscriber(pub_dir)
+    ma, mb = a.poll(), b.poll()
+    assert ma == mb and ma.publish_id == 2
+    assert a.poll() is None  # nothing new
+    # injectable clock: polls inside min_interval_s do not touch disk
+    now = {"t": 100.0}
+    c = DeploySubscriber(pub_dir, min_interval_s=5.0,
+                         clock=lambda: now["t"])
+    assert c.poll().publish_id == 2
+    pub.publish(ckpt)
+    assert c.poll() is None  # rate-limited, not yet due
+    now["t"] += 6.0
+    assert c.poll().publish_id == 3
+
+
+def test_manifest_digest_drift_refused(lm, tmp_path):
+    """A checkpoint silently REPLACED after its manifest landed must not
+    load: the manifest pins the digest recorded at publish time."""
+    _, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub = WeightPublisher(str(tmp_path / "publish"))
+    m = pub.publish(ckpt)
+    # replace with a VALID (atomic_save'd) but different checkpoint
+    save_checkpoint_for(
+        jax.tree_util.tree_map(lambda x: x * 2.0, params), ckpt)
+    with pytest.raises(CheckpointIntegrityError):
+        load_manifest_params(m)
+
+
+def test_loader_refuses_checkpoint_without_params(tmp_path):
+    path = str(tmp_path / "checkpoint_x.pt")
+    atomic_save({"model": {"step": 3}, "args": None}, path)
+    pub = WeightPublisher(str(tmp_path / "publish"))
+    m = pub.publish(path)
+    with pytest.raises(DeployError):
+        load_manifest_params(m)
+
+
+# -- hot-swap: in-flight sequences, pool, page tables survive ---------------
+
+
+def _drive(engine, requests, *, swap_at=None, swap_params=None):
+    """Step the engine to completion, optionally hot-swapping at a step
+    boundary mid-flight; returns ({request_id: tokens}, swap_stall)."""
+    engine.submit([dataclasses.replace(r) for r in requests])
+    finished, steps, stall = [], 0, None
+    while engine.has_work():
+        engine.serve_step()
+        finished.extend(engine.collect_finished())
+        steps += 1
+        if swap_at is not None and steps == swap_at:
+            assert engine.has_work(), "swap must land mid-flight"
+            stall = engine.swap_weights(swap_params)
+        assert steps < 500
+    finished.extend(engine.collect_finished())
+    return {r.request_id: r.tokens for r in finished}, stall
+
+
+def test_swap_mid_flight_is_bit_invisible(lm):
+    """Same-weights swap between serve steps: every stream — including
+    the ones in flight across the boundary — matches the no-swap run
+    bit-exactly, and the pool object/pages are untouched."""
+    model, params = lm
+    reqs = [Request(prompt=[1 + (i * 3) % (V - 1)] * (4 + i),
+                    max_new_tokens=10, seed=i, request_id=f"q{i}")
+            for i in range(6)]
+    baseline, _ = _drive(ServeEngine(model, params, **POOL), reqs)
+    eng = ServeEngine(model, params, **POOL)
+    pool_before = eng.pool
+    swapped, stall = _drive(eng, reqs, swap_at=3,
+                            swap_params=jax.device_get(params))
+    assert swapped == baseline
+    assert eng.pool is pool_before  # the pool survived, not rebuilt
+    assert eng.pool.is_idle()
+    eng.pool.check_invariants()
+    assert eng.weight_swaps == 1 and stall >= 0.0
+
+
+def test_swap_rejects_mismatched_trees(lm):
+    model, params = lm
+    eng = ServeEngine(model, params, **POOL)
+    host = jax.device_get(params)
+    with pytest.raises(WeightSwapError):
+        eng.swap_weights({"decoder": {}})  # different structure
+    bad_shape = jax.tree_util.tree_map(
+        lambda x: np.zeros(tuple(s + 1 for s in np.shape(x)),
+                           np.asarray(x).dtype), host)
+    with pytest.raises(WeightSwapError):
+        eng.swap_weights(bad_shape)
+    bad_dtype = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float16), host)
+    with pytest.raises(WeightSwapError):
+        eng.swap_weights(bad_dtype)
+    # a failed swap leaves the engine serving: no partial install
+    assert eng.weight_swaps == 0
+    [res] = eng.generate([Request(prompt=[1, 2], max_new_tokens=4,
+                                  seed=0, request_id="after")])
+    assert res.finish_reason in ("eos", "length")
+
+
+def test_swap_donation_spares_shared_boot_params(lm):
+    """Boot params may be SHARED across in-process replicas: the first
+    swap must not delete them (engine B keeps serving), while a later
+    swap deletes the buffers the engine itself installed."""
+    model, params = lm
+    host = jax.device_get(params)
+    a = ServeEngine(model, params, **POOL)
+    b = ServeEngine(model, params, **POOL)  # same params tree object
+    a.swap_weights(host)
+    installed = a.params
+    [res] = b.generate([Request(prompt=[1, 2, 3], max_new_tokens=4,
+                                seed=0, request_id="b0")])
+    assert res.finish_reason in ("eos", "length")  # boot buffers alive
+    a.swap_weights(host)
+    deleted = [leaf.is_deleted()
+               for leaf in jax.tree_util.tree_leaves(installed)
+               if isinstance(leaf, jax.Array)]
+    assert deleted and all(deleted)  # owned buffers donated on re-swap
+    [res] = a.generate([Request(prompt=[1, 2, 3], max_new_tokens=4,
+                                seed=0, request_id="a0")])
+    assert res.finish_reason in ("eos", "length")
+
+
+# -- canary rollout state machine -------------------------------------------
+
+
+def _fleet_with_rollout(lm, pub_dir, **ctl_kw):
+    model, params = lm
+    engines = {f"r{i}": ServeEngine(model, params, **POOL)
+               for i in range(2)}
+    router = FleetRouter(engines)
+    kw = dict(canary_steps=8, divert_period=4, seed=0)
+    kw.update(ctl_kw)
+    ctl = RolloutController(router, DeploySubscriber(pub_dir), **kw)
+    return router, engines, ctl
+
+
+def _trace(n=24, seed=1106):
+    return clip_trace(generate_trace(seed, num_requests=n, vocab=V - 1),
+                      MAX_CONTEXT)
+
+
+def test_canary_promotes_good_manifest_fleet_wide(lm, tmp_path):
+    model, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub_dir = str(tmp_path / "publish")
+    WeightPublisher(pub_dir).publish(ckpt, source_step=11)
+    router, engines, ctl = _fleet_with_rollout(lm, pub_dir)
+    trace = _trace()
+    replay_trace(router, trace)
+    results = router.results()
+    assert ctl.state == "idle"
+    assert ctl.stats["promotes"] == 1 and ctl.stats["rollbacks"] == 0
+    assert ctl.current.publish_id == 1 and ctl.current.source_step == 11
+    assert {r: engines[r].weight_swaps
+            for r in sorted(engines)} == {"r0": 1, "r1": 1}
+    # zero-drop: every admitted request finished, solo-oracle exact
+    for ev in trace:
+        res = results[ev.request.request_id]
+        assert res.finish_reason in ("eos", "length")
+        assert res.tokens == solo_tokens(lm, ev.request)
+    assert router.fleet_report()["deploy"]["current"] == 1
+    # the ring healed: canary rejoined after its window
+    assert sorted(router.ring.members()) == ["r0", "r1"]
+
+
+def test_canary_rolls_back_nan_manifest_before_second_replica(lm, tmp_path):
+    model, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"),
+                               poison=True)
+    pub_dir = str(tmp_path / "publish")
+    WeightPublisher(pub_dir).publish(ckpt)
+    router, engines, ctl = _fleet_with_rollout(lm, pub_dir)
+    replay_trace(router, _trace())
+    assert ctl.state == "idle" and ctl.current is None
+    assert ctl.stats["rollbacks"] == 1 and ctl.stats["promotes"] == 0
+    assert 1 in ctl.quarantined
+    assert ctl.breaker.state == "open"
+    # swap + rollback on the canary; the poison NEVER reached r1
+    assert engines["r0"].weight_swaps == 2
+    assert engines["r1"].weight_swaps == 0
+    # post-rollback the canary serves the restored weights
+    req = Request(prompt=[1, 2, 3], max_new_tokens=6, seed=0,
+                  request_id="post")
+    [res] = engines["r0"].generate([dataclasses.replace(req)])
+    assert res.tokens == solo_tokens(lm, req)
+
+
+def test_rollback_restores_prior_promoted_manifest(lm, tmp_path):
+    """Good m1 promotes; NaN m2 rolls back — current must STAY m1 and
+    the canary must serve m1's weights again."""
+    model, params = lm
+    good = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    bad = save_checkpoint_for(params, str(tmp_path / "checkpoint_2.pt"),
+                              poison=True)
+    pub_dir = str(tmp_path / "publish")
+    pub = WeightPublisher(pub_dir)
+    pub.publish(good, source_step=10)
+    router, engines, ctl = _fleet_with_rollout(lm, pub_dir)
+    replay_trace(router, _trace())
+    assert ctl.current.publish_id == 1
+    pub.publish(bad, source_step=20)
+    # breaker is CLOSED (m1 promoted cleanly): m2 canaries immediately
+    replay_trace(router, _trace(seed=1107))
+    assert ctl.current.publish_id == 1  # m1 survived m2's rollback
+    assert ctl.quarantined and 2 in ctl.quarantined
+    assert ctl.stats["promotes"] == 1 and ctl.stats["rollbacks"] == 1
+    assert engines["r1"].weight_swaps == 1  # m1 promote only
+    req = Request(prompt=[2, 4, 6], max_new_tokens=6, seed=1,
+                  request_id="post2")
+    [res] = engines["r0"].generate([dataclasses.replace(req)])
+    assert res.tokens == solo_tokens(lm, req)
+
+
+def test_torn_manifest_condemned_without_any_swap(lm, tmp_path):
+    model, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+    pub_dir = str(tmp_path / "publish")
+    pub = WeightPublisher(pub_dir)
+    m = pub.publish(ckpt)
+    with open(os.path.join(pub_dir, manifest_name(m.publish_id)),
+              "r+b") as fh:
+        fh.write(b"torn!")
+    router, engines, ctl = _fleet_with_rollout(lm, pub_dir)
+    replay_trace(router, _trace(8))
+    assert 1 in ctl.quarantined and "torn" in ctl.quarantined[1]
+    assert ctl.breaker.state == "open"
+    assert all(e.weight_swaps == 0 for e in engines.values())
+
+
+def test_rollout_replay_is_deterministic(lm, tmp_path):
+    model, params = lm
+    ckpt = save_checkpoint_for(params, str(tmp_path / "checkpoint_1.pt"))
+
+    def run(tag):
+        pub_dir = str(tmp_path / f"publish_{tag}")
+        WeightPublisher(pub_dir).publish(ckpt)
+        router, engines, ctl = _fleet_with_rollout(lm, pub_dir)
+        trace = _trace()
+        replay_trace(router, trace)
+        results = router.results()
+        return ({e.request.request_id: results[e.request.request_id].tokens
+                 for e in trace if e.request.request_id in results},
+                dict(ctl.stats),
+                [h["step"] for h in ctl.history])
+
+    assert run("a") == run("b")

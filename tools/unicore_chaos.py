@@ -101,7 +101,18 @@ membership fault:
   --flap           flapping replacements: every factory replacement
                    dies on arrival; the circuit breaker bounds rejoin
                    attempts at flap_limit and holds the slot
-                   quarantined off the ring.
+                   quarantined off the ring;
+  --publish-mid-flood  (ISSUE 18) a weight manifest is published mid
+                   2x-density flood: the canary-gated hot-swap rollout
+                   (unicore_tpu/deploy/) must promote fleet-wide with
+                   ZERO dropped/failed admitted requests, every stream
+                   token-identical across the swap boundary, and the
+                   paged-KV pools + prefix-cache index untouched;
+  --publish-poisoned  (ISSUE 18) NaN-weight and torn-manifest publishes
+                   against live traffic: both must trip the deploy
+                   breaker on the canary, roll back to the pre-swap
+                   weights, quarantine the publish id, and NEVER reach
+                   a second replica.
 
 Input-pipeline legs (``--data``, ISSUE 9 — the fault ladder extended
 into the data layer, docs/fault_tolerance.md "Input pipeline"):
@@ -125,8 +136,9 @@ CI runs: ``unicore_chaos.py --corrupt shard --fsdp-size 2 --devices 2``
 (SIGKILL at a random step + one torn shard + bit-exact resume), the
 ``--inject nonfinite:4`` leg, the ``--zero1 --devices 2`` SIGKILL-resume
 and ``--zero1 --inject nonfinite:4`` legs, the serve poison + graceful +
-flood legs, the four fleet legs (``--rolling``, ``--kill-replica``,
-``--wedge-replica``, ``--flap``), and the ``--data corrupt:2`` +
+flood legs, the six fleet legs (``--rolling``, ``--kill-replica``,
+``--wedge-replica``, ``--flap``, ``--publish-mid-flood``,
+``--publish-poisoned``), and the ``--data corrupt:2`` +
 ``--data hang`` legs.  Exit code 0 iff every assertion holds.
 """
 
@@ -1112,6 +1124,266 @@ def serve_fleet_flap_leg(args, report):
         raise RuntimeError("fleet flap leg: survivor pool pages leaked")
 
 
+def _publish_checkpoint(workdir, params, *, poison=False):
+    """Write a serve-loadable checkpoint (and return its path) the
+    deploy publisher can verify and manifest."""
+    import jax
+    import numpy as np
+
+    from unicore_tpu.checkpoint_utils import atomic_save
+
+    host = jax.device_get(params)
+    if poison:
+        host = jax.tree_util.tree_map(
+            lambda x: np.full_like(np.asarray(x), np.nan), host)
+    name = "checkpoint_poison.pt" if poison else "checkpoint_pub.pt"
+    path = os.path.join(workdir, name)
+    atomic_save({"model": {"params": host}, "args": None}, path)
+    return path
+
+
+def serve_publish_flood_leg(args, report):
+    """``--serve --fleet --publish-mid-flood``: a weight manifest is
+    published mid-way through a seeded 2x-density flood.  The canary
+    swap, gate window, and one-per-step promote must all be invisible
+    to traffic: ZERO dropped or failed admitted requests, every stream
+    token-identical to its solo oracle (the published weights are the
+    serving weights, so a stream crossing the swap boundary must not
+    notice), and the paged-KV pools + prefix-cache index survive the
+    swap untouched.  Run TWICE: bit-identical outcome."""
+    import tempfile
+
+    from unicore_tpu.deploy import DeploySubscriber, RolloutController
+    from unicore_tpu.deploy.publish import WeightPublisher
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import replay_trace
+
+    publish_step = 4
+    model, params, factory, trace = _fleet_setup(args, num_requests=56)
+    print(f"[chaos] publish mid-flood leg: {len(trace)} arrivals into "
+          f"2 replicas; same-weights manifest published at fleet step "
+          f"{publish_step} (twice, asserting determinism)", flush=True)
+
+    def run():
+        workdir = tempfile.mkdtemp(prefix="unicore_chaos_publish_")
+        ckpt = _publish_checkpoint(workdir, params)
+        publisher = WeightPublisher(os.path.join(workdir, "publish"))
+        router = FleetRouter({rid: factory(rid) for rid in ("r0", "r1")})
+        ctl = RolloutController(
+            router, DeploySubscriber(os.path.join(workdir, "publish")),
+            canary_steps=12, divert_period=4, seed=args.seed,
+        )
+        probe = {"in_flight_during_canary": False,
+                 "prefix_hits_at_publish": 0}
+
+        def hook(step, r):
+            if step == publish_step:
+                publisher.publish(ckpt, source_step=100)
+                probe["prefix_hits_at_publish"] = (
+                    r.engines["r0"].stats["prefix_hits"])
+            if ctl.state == "canary" and r.engines["r0"].has_work():
+                # the swap boundary actually crossed live streams
+                probe["in_flight_during_canary"] = True
+
+        replay_trace(router, trace, on_step=hook)
+        out = _fleet_outcome(router, model, params, trace)
+        shutil.rmtree(workdir, ignore_errors=True)
+        return router, ctl, out, probe
+
+    r1, c1, o1, p1 = run()
+    r2, c2, o2, p2 = run()
+    for eng in r1.engines.values():
+        eng.pool.check_invariants()
+    pools_idle = all(e.pool.is_idle() for e in r1.engines.values())
+    swaps = {rid: r1.engines[rid].weight_swaps
+             for rid in sorted(r1.engines)}
+    prefix_hits = sum(e.stats["prefix_hits"] for e in r1.engines.values())
+    deterministic = (o1["tokens"] == o2["tokens"]
+                     and o1["reasons"] == o2["reasons"]
+                     and c1.stats == c2.stats)
+    d = c1.describe()
+    report["fleet_publish"] = {
+        "arrivals": len(trace), "publish_step": publish_step,
+        "missing": o1["missing"], "typed": o1["typed"],
+        "mismatches": o1["mismatches"][:5],
+        "bit_exact_survivors": o1["bit_exact_survivors"],
+        "promotes": d["stats"]["promotes"],
+        "rollbacks": d["stats"]["rollbacks"],
+        "diverted": d["stats"]["diverted"],
+        "weight_swaps": swaps,
+        "current_manifest": d["current"],
+        "in_flight_during_canary": p1["in_flight_during_canary"],
+        "prefix_hits": prefix_hits,
+        "prefix_cache_warm_after_swap": (
+            sum(e.stats["prefix_hits"] for e in r1.engines.values())
+            > p1["prefix_hits_at_publish"]),
+        "pools_idle": pools_idle,
+        "deterministic_replay": deterministic,
+    }
+    if o1["missing"] or o1["typed"]:
+        raise RuntimeError(
+            f"publish mid-flood leg: admitted requests dropped or "
+            f"failed across the swap — missing={o1['missing']} "
+            f"typed={o1['typed']}"
+        )
+    if o1["mismatches"]:
+        raise RuntimeError(
+            f"publish mid-flood leg: {len(o1['mismatches'])} stream(s) "
+            f"diverged across the swap boundary: {o1['mismatches'][:3]}"
+        )
+    if d["stats"]["promotes"] != 1 or d["current"] != 1:
+        raise RuntimeError(
+            f"publish mid-flood leg: the manifest never promoted "
+            f"fleet-wide: {d['stats']} current={d['current']}"
+        )
+    if swaps != {"r0": 1, "r1": 1}:
+        raise RuntimeError(
+            f"publish mid-flood leg: expected exactly one hot-swap per "
+            f"replica, got {swaps}"
+        )
+    if not p1["in_flight_during_canary"]:
+        raise RuntimeError(
+            "publish mid-flood leg: the canary window never overlapped "
+            "in-flight streams — the swap boundary was not exercised"
+        )
+    if not pools_idle:
+        raise RuntimeError("publish mid-flood leg: pool pages leaked "
+                           "across the swap")
+    if not deterministic:
+        raise RuntimeError(
+            f"publish mid-flood leg: replay NOT deterministic — "
+            f"{c1.stats} vs {c2.stats}"
+        )
+
+
+def serve_publish_poisoned_leg(args, report):
+    """``--serve --fleet --publish-poisoned``: two poisoned publishes
+    against live traffic.  A NaN-weight manifest must reach exactly ONE
+    replica (the canary), trip the finite-rows gate, roll back to the
+    pre-swap weights, and leave the deploy breaker open with the id
+    quarantined; a TORN manifest (bytes contradict its .sum marker)
+    must be condemned without any swap at all.  In both cases the
+    second replica never swaps, and the fleet finishes the trace."""
+    import tempfile
+
+    from unicore_tpu.checkpoint_utils import read_sidecar
+    from unicore_tpu.deploy import DeploySubscriber, RolloutController
+    from unicore_tpu.deploy.publish import WeightPublisher, manifest_name
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import replay_trace
+
+    torn_step = 8
+    model, params, factory, trace = _fleet_setup(args)
+    workdir = tempfile.mkdtemp(prefix="unicore_chaos_poisoned_")
+    pub_dir = os.path.join(workdir, "publish")
+    publisher = WeightPublisher(pub_dir)
+    nan_ckpt = _publish_checkpoint(workdir, params, poison=True)
+    good_ckpt = _publish_checkpoint(workdir, params)
+    nan_manifest = publisher.publish(nan_ckpt, source_step=50)
+    print(f"[chaos] publish poisoned leg: NaN manifest "
+          f"{nan_manifest.publish_id} live at start; torn manifest "
+          f"published at fleet step {torn_step}", flush=True)
+
+    router = FleetRouter({rid: factory(rid) for rid in ("r0", "r1")})
+    ctl = RolloutController(
+        router, DeploySubscriber(pub_dir),
+        canary_steps=6, divert_period=4, seed=args.seed,
+    )
+
+    def hook(step, r):
+        del r
+        if step == torn_step:
+            m = publisher.publish(good_ckpt, source_step=60)
+            # torn-write simulation: the data bytes change AFTER the
+            # .sum marker landed — exactly what a crash mid-copy or a
+            # tampered file looks like to the verifier
+            with open(os.path.join(pub_dir,
+                                   manifest_name(m.publish_id)),
+                      "r+b") as fh:
+                fh.write(b"torn!")
+            read_sidecar(m.path)  # marker still present -> "torn"
+
+    replay_trace(router, trace, on_step=hook)
+    # the trace may end before the torn publish settles: step the idle
+    # fleet so the subscriber provably sees (and condemns) it
+    for _ in range(20):
+        router.step()
+    router.collect()
+    out = _fleet_outcome(router, model, params, trace)
+    for eng in router.engines.values():
+        eng.pool.check_invariants()
+    swaps = {rid: router.engines[rid].weight_swaps
+             for rid in sorted(router.engines)}
+    d = ctl.describe()
+    failed_only_typed = all(reason == "failed"
+                            for _, reason in out["typed"])
+    report["fleet_publish_poisoned"] = {
+        "arrivals": len(trace), "torn_step": torn_step,
+        "missing": out["missing"], "typed": out["typed"],
+        "mismatches": out["mismatches"][:5],
+        "weight_swaps": swaps,
+        "rollbacks": d["stats"]["rollbacks"],
+        "promotes": d["stats"]["promotes"],
+        "quarantined": {str(k): v for k, v in d["quarantined"].items()},
+        "breaker_state": d["breaker"]["state"],
+        "current_manifest": d["current"],
+        "history": d["history"],
+        "pools_idle": all(e.pool.is_idle()
+                          for e in router.engines.values()),
+    }
+    shutil.rmtree(workdir, ignore_errors=True)
+    if out["missing"]:
+        raise RuntimeError(
+            f"publish poisoned leg: requests vanished: {out['missing']}"
+        )
+    if out["mismatches"]:
+        raise RuntimeError(
+            f"publish poisoned leg: surviving streams diverged from "
+            f"the solo oracle: {out['mismatches'][:3]}"
+        )
+    if not failed_only_typed:
+        raise RuntimeError(
+            f"publish poisoned leg: unexpected terminal reasons "
+            f"(only the NaN-window quarantines may fail): "
+            f"{out['typed']}"
+        )
+    if swaps.get("r1", 0) != 0:
+        raise RuntimeError(
+            f"publish poisoned leg: the poison reached a SECOND "
+            f"replica — swaps {swaps}"
+        )
+    if swaps.get("r0", 0) != 2:
+        raise RuntimeError(
+            f"publish poisoned leg: canary swap+rollback expected on "
+            f"r0 (2 swaps), got {swaps}"
+        )
+    if d["stats"]["rollbacks"] < 2 or d["stats"]["promotes"] != 0:
+        raise RuntimeError(
+            f"publish poisoned leg: both poisoned publishes must be "
+            f"condemned and none promoted: {d['stats']}"
+        )
+    if sorted(d["quarantined"]) != [1, 2]:
+        raise RuntimeError(
+            f"publish poisoned leg: expected publish ids 1 (NaN) and "
+            f"2 (torn) quarantined, got {d['quarantined']}"
+        )
+    if "torn" not in d["quarantined"][2]:
+        raise RuntimeError(
+            f"publish poisoned leg: id 2 was not condemned as TORN: "
+            f"{d['quarantined'][2]!r}"
+        )
+    if d["breaker"]["state"] != "open":
+        raise RuntimeError(
+            f"publish poisoned leg: deploy breaker not open after the "
+            f"poison: {d['breaker']}"
+        )
+    if d["current"] is not None:
+        raise RuntimeError(
+            f"publish poisoned leg: a poisoned manifest became "
+            f"current: {d['current']}"
+        )
+
+
 def serve_main(args):
     import tempfile
 
@@ -1141,11 +1413,14 @@ def serve_main(args):
             ("kill-replica", args.kill_replica),
             ("wedge-replica", args.wedge_replica),
             ("flap", args.flap),
+            ("publish-mid-flood", args.publish_mid_flood),
+            ("publish-poisoned", args.publish_poisoned),
         ) if on]
         if not wanted:
             raise SystemExit(
                 "--serve --fleet needs at least one of --rolling, "
-                "--kill-replica, --wedge-replica, --flap"
+                "--kill-replica, --wedge-replica, --flap, "
+                "--publish-mid-flood, --publish-poisoned"
             )
         if args.rolling:
             serve_fleet_rolling_leg(args, report)
@@ -1159,6 +1434,12 @@ def serve_main(args):
         if args.flap:
             serve_fleet_flap_leg(args, report)
             legs.append("fleet-flap")
+        if args.publish_mid_flood:
+            serve_publish_flood_leg(args, report)
+            legs.append("fleet-publish")
+        if args.publish_poisoned:
+            serve_publish_poisoned_leg(args, report)
+            legs.append("fleet-publish-poisoned")
     if not legs:
         raise SystemExit(
             "--serve needs at least one of --inject poison:K, --flood, "
@@ -1572,6 +1853,19 @@ def build_parser():
                         "the circuit breaker must bound rejoin "
                         "attempts at flap_limit and hold the slot "
                         "quarantined off the ring")
+    p.add_argument("--publish-mid-flood", action="store_true",
+                   help="(with --serve --fleet) a weight manifest is "
+                        "published mid 2x-density flood: the canary-"
+                        "gated hot-swap rollout must promote fleet-wide "
+                        "with zero dropped/failed requests, every "
+                        "stream token-identical across the swap "
+                        "boundary, and the KV pools + prefix cache "
+                        "untouched (docs/deployment.md)")
+    p.add_argument("--publish-poisoned", action="store_true",
+                   help="(with --serve --fleet) NaN-weight and torn-"
+                        "manifest publishes against live traffic: both "
+                        "must trip the deploy breaker on the canary, "
+                        "roll back, and never reach a second replica")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")

@@ -137,6 +137,21 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   IO failures into it (the way ``indexed_dataset``/``lmdb_dataset``
   do).
 
+- UL116 unverified-checkpoint-read: a raw ``open(...)`` or
+  ``pickle.load``/``loads`` whose argument names a checkpoint or
+  manifest (``checkpoint``/``ckpt``/``manifest`` name fragments, or a
+  ``.pt`` literal) in deploy/serve/fleet code, outside both the
+  sanctioned ``read_verified(...)`` wrapper and any ``try`` whose
+  handler re-raises a typed error.  The deploy pipeline's whole
+  contract is that a torn or tampered checkpoint can never reach a
+  ServeEngine: ``read_verified`` re-hashes the bytes against the
+  ``.sum`` sidecar and raises ``CheckpointIntegrityError``, and every
+  manifest/params load path (``deploy/publish.py``,
+  ``deploy/loader.py``) goes through it.  A bare read bypasses the
+  integrity ladder exactly where it matters most — weights about to be
+  hot-swapped into live traffic.  Train-side code is exempt (its reads
+  are guarded by the checkpoint_utils load path itself).
+
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
 """
@@ -263,6 +278,10 @@ _UL115_SHUTDOWN_METHODS = {"stop", "close", "drain", "shutdown",
                            "terminate", "join"}
 
 
+# UL116: argument-name fragments that mark a read as checkpoint bytes
+_UL116_NAME_HINTS = ("checkpoint", "ckpt", "manifest")
+
+
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
     parts = []
@@ -276,9 +295,10 @@ def _attr_chain(node):
 
 
 class _ModuleLint(ast.NodeVisitor):
-    def __init__(self, path, source, *, dataset_file, lines):
+    def __init__(self, path, source, *, dataset_file, deploy_file, lines):
         self.path = path
         self.dataset_file = dataset_file
+        self.deploy_file = deploy_file
         self.lines = lines
         self.findings = []
         # alias tracking: import numpy as np / import random as rnd
@@ -1452,10 +1472,109 @@ class _ModuleLint(ast.NodeVisitor):
                 if node.name == "init":
                     self._check_optim_init_allocations(node)
 
+    # -- UL116 ---------------------------------------------------------
+
+    def _ul116_io_kind(self, call):
+        """Classify a call as raw checkpoint-bytes IO: ``open`` or a
+        pickle ``load``/``loads``."""
+        chain = _attr_chain(call.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] == "open" or parts[-1] == "open":
+            return "open()"
+        if (len(parts) > 1 and parts[-1] in ("load", "loads")
+                and "pickle" in parts[0].lower()):
+            return f"'{chain}'"
+        return None
+
+    @staticmethod
+    def _ul116_hinted(call):
+        """Does any argument name checkpoint/manifest bytes?  Matches
+        name fragments on identifiers/attributes and ``.pt``/fragment
+        hits in string literals (f-string pieces included)."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if (isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)):
+                    s = sub.value.lower()
+                    if (s.endswith(".pt") or ".pt" in s
+                            or any(h in s for h in _UL116_NAME_HINTS)):
+                        return True
+                name = None
+                if isinstance(sub, ast.Name):
+                    name = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    name = sub.attr
+                if name and any(h in name.lower()
+                                for h in _UL116_NAME_HINTS):
+                    return True
+        return False
+
+    @staticmethod
+    def _ul116_verified(call):
+        """Sanctioned shape: the bytes come straight out of
+        ``read_verified(...)`` (``pickle.loads(read_verified(p))``)."""
+        for arg in call.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func)
+                    if chain and chain.split(".")[-1] == "read_verified":
+                        return True
+        return False
+
+    def _check_checkpoint_reads(self):
+        """UL116 over the whole module (deploy/serve/fleet files only):
+        every checkpoint/manifest read must go through
+        ``read_verified`` or sit under a ``try`` whose handler
+        re-raises the typed integrity error."""
+        def enter(node, guarded):
+            # a def inside a try runs LATER, outside the guard
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                guarded = False
+            walk(node, guarded)
+
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Try):
+                    covers = guarded or any(
+                        self._handler_reraises(h) for h in child.handlers
+                    )
+                    for stmt in child.body:
+                        enter(stmt, covers)
+                    for h in child.handlers:
+                        for stmt in h.body:
+                            enter(stmt, guarded)
+                    for stmt in child.orelse + child.finalbody:
+                        enter(stmt, guarded)
+                    continue
+                if isinstance(child, ast.Call) and not guarded:
+                    kind = self._ul116_io_kind(child)
+                    if (kind and self._ul116_hinted(child)
+                            and not self._ul116_verified(child)):
+                        self.emit(
+                            "UL116", "unverified-checkpoint-read",
+                            "error", child,
+                            f"{kind} reads checkpoint/manifest bytes "
+                            f"outside read_verified and any typed "
+                            f"re-raise — a torn or tampered file "
+                            f"bypasses the integrity ladder on the "
+                            f"path that hot-swaps weights into live "
+                            f"traffic; load through read_verified "
+                            f"(deploy/loader.py, deploy/publish.py) "
+                            f"or re-raise CheckpointIntegrityError",
+                        )
+                enter(child, guarded)
+
+        if self.deploy_file:
+            walk(self._tree, False)
+
     def run(self):
         self.visit(self._tree)
         self._visit_functions()
         self._check_daemon_threads()
+        self._check_checkpoint_reads()
         return self.findings
 
 
@@ -1463,6 +1582,15 @@ def _is_dataset_file(path):
     norm = path.replace(os.sep, "/")
     return ("/data/" in norm or norm.endswith("_dataset.py")
             or "dataset" in os.path.basename(norm))
+
+
+def _is_deploy_file(path):
+    """UL116 scope: the serve-side code a checkpoint flows through on
+    its way into live traffic (train-side reads are guarded by the
+    checkpoint_utils load path itself)."""
+    norm = path.replace(os.sep, "/")
+    return any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+               for d in ("deploy", "serve", "fleet"))
 
 
 def lint_file(path, *, rel_to=None):
@@ -1473,6 +1601,7 @@ def lint_file(path, *, rel_to=None):
         linter = _ModuleLint(
             rel, source,
             dataset_file=_is_dataset_file(rel),
+            deploy_file=_is_deploy_file(rel),
             lines=source.splitlines(),
         )
     except SyntaxError as e:

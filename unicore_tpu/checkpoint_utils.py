@@ -161,6 +161,30 @@ def atomic_save(obj, filename, retries=3, backoff=0.5):
             time.sleep(backoff * (2 ** attempt))
 
 
+def read_sidecar(filename):
+    """Parse the ``.sum`` marker for ``filename`` (``{"algo", "digest",
+    "size"}``).  Raises :class:`CheckpointIntegrityError` when the
+    sidecar is absent or unparseable — callers (the deploy publisher,
+    which records the digest into its manifest) need the marker
+    itself, not the payload, and must not fabricate one."""
+    try:
+        with open(_sum_path(filename), "rb") as f:
+            marker = json.loads(f.read().decode())
+    except FileNotFoundError as e:
+        raise CheckpointIntegrityError(
+            f"{filename} has no .sum sidecar to read"
+        ) from e
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable .sum sidecar for {filename}: {e}"
+        ) from e
+    if "digest" not in marker:
+        raise CheckpointIntegrityError(
+            f"malformed .sum sidecar for {filename}: {marker!r}"
+        )
+    return marker
+
+
 def _sidecar_required(filename):
     """Is a missing ``.sum`` sidecar proof of a torn save for this file?
 
@@ -560,12 +584,21 @@ class CheckpointManager:
         # checkpoint_save_stall_ms bench metric reads these deltas
         self.stall_s = 0.0
         self.saves = 0
+        self._publisher = None
         if is_master and not args.no_save:
             verify_checkpoint_directory(args.save_dir)
             verify_checkpoint_directory(args.tmp_save_dir)
             if self.async_save:
                 self._writer = self._make_writer()
             self._sweep_stale_scratch()
+            if getattr(args, "publish_dir", ""):
+                # train->serve bridge (docs/deployment.md): every
+                # finalized save also lands a verified manifest in the
+                # watched publish dir.  Runtime import — deploy imports
+                # this module at its top level.
+                from unicore_tpu.deploy import WeightPublisher
+
+                self._publisher = WeightPublisher(args.publish_dir)
 
     def _make_writer(self):
         from unicore_tpu.resilience import AsyncCheckpointWriter
@@ -705,6 +738,7 @@ class CheckpointManager:
         job = functools.partial(
             self._write_and_finalize, state_dict, shard_entries, scratch,
             finals, end_of_epoch, is_master, jax.process_index(),
+            publish_step=updates,
         )
         if self.async_save:
             if self._writer is None:
@@ -752,7 +786,8 @@ class CheckpointManager:
             self._writer.poll()
 
     def _write_and_finalize(self, state_dict, shard_entries, scratch,
-                            finals, end_of_epoch, is_master, process_index):
+                            finals, end_of_epoch, is_master, process_index,
+                            publish_step=0):
         """Writer-thread body: serialize, copy to final names, prune.
         Raises on write/copy failure — the async writer records it and
         :meth:`poll` re-raises at the next step boundary (UL107: no
@@ -763,6 +798,23 @@ class CheckpointManager:
         )
         self._finalize(scratch, finals, end_of_epoch, is_master,
                        bool(shard_entries), process_index)
+        if (self._publisher is not None and is_master
+                and process_index == 0):
+            # publish AFTER the save fully landed, and never fail the
+            # save over it: a publish fault costs one rollout, a raised
+            # one would cost the checkpoint
+            try:
+                m = self._publisher.publish(finals[0],
+                                            source_step=publish_step)
+                logger.info(
+                    "published manifest %d -> %s (step %d)",
+                    m.publish_id, finals[0], publish_step,
+                )
+            except Exception:
+                logger.error(
+                    "weight publish of %s failed; training and the "
+                    "checkpoint are unaffected", finals[0], exc_info=True,
+                )
 
     def _finalize(self, scratch, finals, end_of_epoch, is_master=True,
                   has_shards=False, process_index=0):

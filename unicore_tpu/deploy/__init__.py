@@ -1,0 +1,25 @@
+"""Train-to-serve continuous deployment (ISSUE 18).
+
+The bridge between the checkpoint machinery and the serve fleet:
+:class:`~unicore_tpu.deploy.publish.WeightPublisher` lands verified,
+versioned manifests into a watched directory as training checkpoints
+finalize; :class:`~unicore_tpu.deploy.subscriber.DeploySubscriber`
+surfaces them deterministically at the fleet router's step boundary;
+:class:`~unicore_tpu.deploy.rollout.RolloutController` walks them
+through a canary-gated, zero-downtime hot-swap rollout
+(promote/rollback).  See docs/deployment.md for the lifecycle.
+"""
+
+from .loader import (load_manifest_params, load_serve_model,
+                     load_serve_params)
+from .publish import (DeployError, Manifest, WeightPublisher,
+                      manifest_name, read_manifest, scan_publish_dir)
+from .rollout import RolloutController
+from .subscriber import DeploySubscriber
+
+__all__ = [
+    "DeployError", "Manifest", "WeightPublisher", "manifest_name",
+    "read_manifest", "scan_publish_dir", "DeploySubscriber",
+    "RolloutController", "load_manifest_params", "load_serve_model",
+    "load_serve_params",
+]

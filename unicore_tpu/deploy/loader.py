@@ -1,0 +1,109 @@
+"""The ONE checkpoint->serve-params load path (ISSUE 18 satellite).
+
+Before this module the serve CLI owned a private copy of the
+checkpoint->params logic; the hot-swap and rollback paths would each
+have grown a third and fourth.  Every consumer now goes through here:
+
+- CLI startup: :func:`load_serve_model` (build the registered arch
+  from the checkpoint's own args + a dictionary, then the params).
+- Hot-swap / rollback: :func:`load_manifest_params` (re-verify the
+  checkpoint against the digest the manifest recorded at publish
+  time, then just the params — the engine already has the model).
+
+All reads are backed by :func:`~unicore_tpu.checkpoint_utils.
+load_checkpoint_to_cpu`, i.e. ``read_verified`` + typed integrity
+errors; a torn checkpoint can not reach a ServeEngine through any of
+these functions.  Params come back as HOST (numpy) leaves — each
+engine's :meth:`~unicore_tpu.serve.engine.ServeEngine.swap_weights`
+uploads its own device copy, so two replicas never alias (and later
+donate) the same buffers.
+"""
+
+import logging
+import os
+
+from unicore_tpu.checkpoint_utils import (CheckpointIntegrityError,
+                                          ShardedLeaf,
+                                          load_checkpoint_to_cpu,
+                                          read_sidecar)
+
+from .publish import DeployError
+
+logger = logging.getLogger(__name__)
+
+
+def _params_of(state, path):
+    """Pull the serve params tree out of a train checkpoint state dict
+    (``model.params`` — the fp32 master tree), failing typed on the
+    states serving cannot use."""
+    import jax
+
+    try:
+        tree = state["model"]["params"]
+    except (KeyError, TypeError) as e:
+        raise DeployError(
+            f"{path} has no model.params tree to serve from"
+        ) from e
+    if any(isinstance(leaf, ShardedLeaf)
+           for leaf in jax.tree_util.tree_leaves(tree)):
+        raise DeployError(
+            f"{path} is a SHARDED checkpoint (FSDP/TP run: params live "
+            "in .shard* sibling files); consolidate it first — resume "
+            "the run on one host and save, or load via "
+            "Trainer.load_checkpoint"
+        )
+    return tree
+
+
+def load_serve_params(path):
+    """Verified checkpoint -> host params tree (numpy leaves)."""
+    return _params_of(load_checkpoint_to_cpu(path), path)
+
+
+def load_serve_model(path, dict_path):
+    """Verified checkpoint + dictionary -> ``(model, params)`` with
+    device-ready params — the CLI startup path."""
+    import jax
+    import jax.numpy as jnp
+
+    from examples.lm.model import TransformerLMModel  # registers the arch
+    from unicore_tpu.data import Dictionary
+    from unicore_tpu.models import ARCH_MODEL_REGISTRY
+
+    del TransformerLMModel
+    state = load_checkpoint_to_cpu(path)
+    args = state["args"]
+    dictionary = Dictionary.load(dict_path)
+
+    class _Task:
+        pass
+
+    task = _Task()
+    task.dictionary = dictionary
+    arch = getattr(args, "arch", "transformer_lm")
+    model = ARCH_MODEL_REGISTRY[arch].build_model(args, task)
+    # checkpoint "model" is the TRAIN state {opt_state, params, step};
+    # serving needs the fp32 master params tree (numpy leaves upload on
+    # first use)
+    params = jax.tree_util.tree_map(jnp.asarray, _params_of(state, path))
+    return model, params
+
+
+def load_manifest_params(manifest):
+    """Manifest -> host params tree, re-verifying the checkpoint
+    against the digest recorded AT PUBLISH TIME.  Catches both a torn
+    file (``read_verified``) and a checkpoint silently replaced after
+    its manifest landed (sidecar digest drift vs the manifest's
+    record) — either way the swap never sees the bytes."""
+    path = manifest.checkpoint
+    recorded = manifest.sha256.get(os.path.basename(path))
+    if recorded is not None:
+        side = read_sidecar(path)
+        if side["digest"] != recorded:
+            raise CheckpointIntegrityError(
+                f"checkpoint {path} digest {side['digest'][:12]}… does "
+                f"not match the digest manifest {manifest.publish_id} "
+                f"recorded at publish time ({recorded[:12]}…) — the "
+                f"file changed after it was published"
+            )
+    return load_serve_params(path)

@@ -147,6 +147,17 @@ class FleetRouter:
             "replicas_lost": 0, "rejoins": 0,
         }
         self._auto_id = 0
+        self._deploy = None  # RolloutController hook (ISSUE 18)
+
+    def attach_deploy(self, controller):
+        """Wire a deploy :class:`~unicore_tpu.deploy.rollout.
+        RolloutController` into the router: it is polled once per
+        fleet step (after every replica stepped — the step boundary),
+        may divert a seeded slice of new submits to its off-ring
+        canary, and observes every settled result for its TTFT
+        watermark."""
+        self._deploy = controller
+        return controller
 
     def _make_child(self, rid):
         if self.shutdown is not None:
@@ -166,6 +177,13 @@ class FleetRouter:
         if rid in self._replica_of or rid in self._results:
             raise ValueError(f"duplicate request_id {rid!r}")
         session = session_key if session_key is not None else rid
+        if self._deploy is not None:
+            canary = self._deploy.divert(request, session)
+            if canary is not None and canary in self.engines:
+                self.engines[canary].submit([request])
+                self.stats["routed"] += 1
+                self._record_assignment(rid, session, canary)
+                return canary
         choice, reason = self._route(request, session)
         self.engines[choice].submit([request])
         self.stats["routed"] += 1
@@ -245,7 +263,8 @@ class FleetRouter:
     def has_work(self):
         return (any(e.has_work() for e in self.engines.values())
                 or any(p["engine"].has_work()
-                       for p in self._probation.values()))
+                       for p in self._probation.values())
+                or (self._deploy is not None and self._deploy.active()))
 
     def step(self):
         """One cooperative fleet step: every replica advances by one
@@ -260,9 +279,15 @@ class FleetRouter:
         if self._step_probation():
             busy = True
         self._tick_breakers()
+        if self._deploy is not None:
+            # the STEP BOUNDARY: every replica has stepped, nothing is
+            # mid-dispatch — the only point where a weight swap is legal
+            self._deploy.on_step(self._fleet_step)
         # a probe launched by the tick above has not stepped yet: keep
-        # the drive loop alive until its canary settles
-        return busy or bool(self._probation)
+        # the drive loop alive until its canary settles; an active
+        # rollout likewise holds the drive loop open
+        return (busy or bool(self._probation)
+                or (self._deploy is not None and self._deploy.active()))
 
     def _step_replica(self, rid):
         """One GUARDED serve_step on replica ``rid``: typed fault
@@ -307,6 +332,8 @@ class FleetRouter:
         self._replica_of.pop(res.request_id, None)
         self._session_of.pop(res.request_id, None)
         self._failovers.pop(res.request_id, None)
+        if self._deploy is not None:
+            self._deploy.observe_result(res)
 
     def run_until_complete(self):
         """Drive the whole fleet to an empty queue and return the
@@ -695,4 +722,6 @@ class FleetRouter:
             "breakers": {str(rid): br.describe()
                          for rid, br in sorted(self._breakers.items())},
             "probation": sorted(map(str, self._probation)),
+            "deploy": (None if self._deploy is None
+                       else self._deploy.describe()),
         }

@@ -113,6 +113,16 @@ def make_parser():
                           "flap window hold the replica slot "
                           "quarantined — a flapping replica cannot "
                           "thrash the ring (default: 3)")
+    flt.add_argument("--publish-dir", default=None,
+                     help="deploy: watch this directory for published "
+                          "weight manifests and roll them out live via "
+                          "the canary-gated hot-swap pipeline "
+                          "(docs/deployment.md)")
+    flt.add_argument("--canary-steps", type=int, default=24,
+                     help="deploy: fleet steps the canary replica "
+                          "serves new weights off-ring before the SLO "
+                          "gates decide promote vs rollback "
+                          "(default: 24)")
     rob = p.add_argument_group(
         "robustness (docs/serving.md#robustness)")
     rob.add_argument("--max-waiting", type=int, default=None,
@@ -166,42 +176,15 @@ def _demo_model(seed):
 
 
 def _checkpoint_model(path, dict_path):
-    import jax
-    import jax.numpy as jnp
+    # the checkpoint->serve-params logic lives in deploy.loader (the
+    # hot-swap path shares it); the CLI's only job is turning typed
+    # deploy faults into an operator-facing exit
+    from unicore_tpu.deploy import DeployError, load_serve_model
 
-    from examples.lm.model import TransformerLMModel  # registers the arch
-    from unicore_tpu.checkpoint_utils import load_checkpoint_to_cpu
-    from unicore_tpu.data import Dictionary
-    from unicore_tpu.models import ARCH_MODEL_REGISTRY
-
-    del TransformerLMModel
-    state = load_checkpoint_to_cpu(path)
-    args = state["args"]
-    dictionary = Dictionary.load(dict_path)
-
-    class _Task:
-        pass
-
-    task = _Task()
-    task.dictionary = dictionary
-    arch = getattr(args, "arch", "transformer_lm")
-    model = ARCH_MODEL_REGISTRY[arch].build_model(args, task)
-    # checkpoint "model" is the TRAIN state {opt_state, params, step};
-    # serving needs the fp32 master params tree (numpy leaves upload on
-    # first use)
-    from unicore_tpu.checkpoint_utils import ShardedLeaf
-
-    tree = state["model"]["params"]
-    if any(isinstance(leaf, ShardedLeaf)
-           for leaf in jax.tree_util.tree_leaves(tree)):
-        raise SystemExit(
-            f"{path} is a SHARDED checkpoint (FSDP/TP run: params live "
-            "in .shard* sibling files); consolidate it first — resume "
-            "the run on one host and save, or load via "
-            "Trainer.load_checkpoint"
-        )
-    params = jax.tree_util.tree_map(jnp.asarray, tree)
-    return model, params
+    try:
+        return load_serve_model(path, dict_path)
+    except DeployError as e:
+        raise SystemExit(str(e)) from e
 
 
 def _demo_requests(args, vocab, rng):
@@ -294,6 +277,15 @@ def _fleet_main(args, model, params, requests, shutdown):
             flap_limit=args.flap_limit,
         ),
     )
+    if args.publish_dir:
+        from unicore_tpu.deploy import DeploySubscriber, RolloutController
+
+        # the controller attaches itself to the router; its describe()
+        # rides out through fleet_report()["deploy"]
+        RolloutController(
+            router, DeploySubscriber(args.publish_dir),
+            canary_steps=args.canary_steps,
+        )
     logger.info(
         "fleet: %d request(s) over %d session(s) into %d replica(s) "
         "(pool %d pages x %d slots each, max batch %d)",

@@ -98,6 +98,15 @@ class ServeResult:
     evictions: int
 
 
+class WeightSwapError(RuntimeError):
+    """Typed hot-swap precondition failure: the incoming param tree
+    does not match the serving tree (structure, leaf shape, or dtype).
+    A swap that would force a recompile — or worse, silently reshape
+    what the cached jitted step programs close over — must fail BEFORE
+    touching the engine; the caller (deploy rollout) treats this like
+    any other bad-manifest fault: quarantine and roll back."""
+
+
 DEFAULT_PREFILL_CHUNK = 32
 
 
@@ -201,6 +210,12 @@ class ServeEngine:
             "capacity_failfast": 0, "peak_waiting": 0,
             "prefix_hits": 0, "prefix_tokens_saved": 0,
         }
+        # live weight swaps installed via swap_weights (ISSUE 18);
+        # _owns_params flips on the first swap — boot params may be
+        # SHARED (other replicas in an in-process fleet, the trainer),
+        # so only buffers the engine placed itself are donation-safe
+        self.weight_swaps = 0
+        self._owns_params = False
 
     # -- pool buffers --------------------------------------------------
 
@@ -1086,3 +1101,85 @@ class ServeEngine:
         self.drain_report = None
         if self.shutdown is not None and hasattr(self.shutdown, "clear"):
             self.shutdown.clear()  # ChildShutdown: fleet-wide reads through
+
+    # -- live weight hot-swap (ISSUE 18) -------------------------------
+
+    def swap_weights(self, new_params, *, donate=None):
+        """Install ``new_params`` IN PLACE between serve steps, donating
+        the old param buffers the engine owns.
+
+        The cached jitted step programs take params as a NON-donated
+        argument, so replacing :attr:`params` with a tree of identical
+        structure/shapes/dtypes reuses every compiled program — no
+        retrace, no recompile.  Everything else survives untouched: the
+        paged KV pool, the prefix-cache index, page tables, and every
+        in-flight sequence (their KV history was computed token by
+        token and lives in the pool, not in the weights).
+
+        Same tree structure + per-leaf shape/dtype is a HARD
+        precondition — violations raise :class:`WeightSwapError` before
+        the engine is touched.  On success the OLD leaves are deleted
+        explicitly (donation-in-place): during a rollout HBM must hold
+        one param set per replica plus the pool, never two param sets
+        waiting on the garbage collector.  ``donate=None`` (auto)
+        deletes only buffers a PREVIOUS swap installed — the boot
+        params may be shared (sibling replicas of an in-process fleet,
+        or the trainer that built them) and the engine cannot prove
+        ownership of what it did not place; pass ``donate=True`` when
+        the caller guarantees exclusive ownership, ``donate=False`` to
+        never delete.
+
+        Must be called at a step boundary (the deploy subscriber hooks
+        the fleet router's step loop); never from inside a dispatch.
+        Returns the host-side stall in seconds."""
+        old = self.params
+        old_struct = jax.tree_util.tree_structure(old)
+        new_struct = jax.tree_util.tree_structure(new_params)
+        if new_struct != old_struct:
+            raise WeightSwapError(
+                f"param tree structure mismatch: engine serves "
+                f"{old_struct}, swap offered {new_struct}"
+            )
+        old_leaves = jax.tree_util.tree_leaves(old)
+        new_leaves = jax.tree_util.tree_leaves(new_params)
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            o_shape, n_shape = tuple(np.shape(o)), tuple(np.shape(n))
+            o_dtype = np.asarray(o).dtype if not hasattr(o, "dtype") \
+                else o.dtype
+            n_dtype = np.asarray(n).dtype if not hasattr(n, "dtype") \
+                else n.dtype
+            if o_shape != n_shape or o_dtype != n_dtype:
+                raise WeightSwapError(
+                    f"param leaf {i} mismatch: engine serves "
+                    f"{o_shape}/{o_dtype}, swap offered "
+                    f"{n_shape}/{n_dtype}"
+                )
+        t0 = self._clock()
+        placed = jax.tree_util.tree_map(jnp.asarray, new_params)
+        # commit before the cutover: a device transfer failing halfway
+        # must leave the engine on its OLD params, not a broken tree.
+        # The sync is the point — swap_weights runs at a step boundary
+        # (never inside a dispatch) and RETURNS the measured stall
+        jax.block_until_ready(placed)  # unicore-lint: disable=UL104
+        self.params = placed
+        if donate is None:
+            donate = self._owns_params
+        if donate:
+            placed_ids = {id(leaf)
+                          for leaf in jax.tree_util.tree_leaves(placed)}
+            for leaf in old_leaves:
+                # a self-swap (rollback to buffers the caller still
+                # holds) must not delete the arrays it just installed
+                if id(leaf) in placed_ids or not isinstance(leaf, jax.Array):
+                    continue
+                if not leaf.is_deleted():
+                    leaf.delete()
+        self._owns_params = True
+        self.weight_swaps += 1
+        stall = self._clock() - t0
+        metrics.log_scalar("serve/weight_swap_stall_ms", stall * 1e3)
+        logger.info(
+            "weight swap #%d installed (%d leaves, %.2f ms host stall)",
+            self.weight_swaps, len(old_leaves), stall * 1e3,
+        )
+        return stall
