@@ -1928,6 +1928,33 @@ def _e2e_backend_speedup(cfg):
     return round(ratio, 3), spread
 
 
+def _determinism_micro(out):
+    """Cost of a Pass-5 runtime replay (ISSUE 19): capture one real
+    dispatch of the shrunk 2x64 jitted train step (via the trainer's
+    ``_input_capture`` hook, host copies taken before donation) and
+    re-execute it on the identical inputs — the steady-state replay
+    wall time is what a replay-verified step costs on top of a normal
+    one.  The runs must come back bit-exact; a divergence here is a
+    bench FAILURE, not a number."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "unicore_determinism.py")
+    spec = importlib.util.spec_from_file_location(
+        "unicore_determinism", path)
+    ud = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ud)
+
+    # runs=3: replay_ms[0] pays the jit-call-path placement/compile;
+    # the later replays are the steady state the metric names
+    report = ud.run_train(runs=3)
+    if not report["deterministic"]:
+        raise RuntimeError(f"train replay diverged: {report}")
+    out["determinism_replay_bytes"] = report["bytes_compared"]
+    out["determinism_replay_leaves"] = report["leaves"]
+    return round(min(report["replay_ms"][1:]), 3)
+
+
 def _cpu_tier_main():
     """``BENCH_CPU_TIER=1``: the host-semantics micro set on a CPU
     container — the fleet SLO report under the committed trace seed
@@ -1953,6 +1980,8 @@ def _cpu_tier_main():
         ("pipeline_depth_speedup", lambda: _pipeline_micro(micro)),
         ("zero1_step_overhead_ratio", lambda: _zero1_micros(micro)),
         ("packed_batch_tokens_per_sec", lambda: _packed_micro(micro)),
+        ("determinism_replay_overhead_ms",
+         lambda: _determinism_micro(micro)),
     ):
         _micro_guard(micro, name, fn)
     out = {

@@ -1,0 +1,429 @@
+"""unicore-lint Pass 5 (determinism) + runtime harness (ISSUE 19).
+
+Static rules get the fire/silent/suppression treatment every other pass
+gets: UL401 on synthetic HLO text, UL402 on text pairs plus a real
+double-lower identity check on the dp mesh, UL403 on AST fixtures, and
+the UL117 source-lint satellite on wall-clock fixture files.  The repo
+sweeps (planning modules, decision-path source files) are pinned clean
+so any regression names the exact new finding.  The runtime harness is
+exercised both green (healthy jitted step double-runs bit-exact) and
+red (a trace-time-gated pure_callback divergence must be localized to
+the right primitive by the digest-stream bisector).
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.analysis.determinism_audit import (
+    DEFAULT_UL401_WHITELIST,
+    PLANNING_MODULES,
+    audit_determinism_text,
+    audit_planning_modules,
+    audit_planning_source,
+    audit_program_identity,
+)
+from unicore_tpu.analysis.source_lint import lint_paths
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _repo_root():
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# UL401 nondeterministic-execution signatures (synthetic HLO)
+# ---------------------------------------------------------------------
+
+def test_ul401_fires_on_colliding_scatter():
+    hlo = textwrap.dedent("""\
+        HloModule grad_step
+        ENTRY main {
+          %p0 = f32[128,64]{1,0} parameter(0)
+          %upd = f32[128,64]{1,0} scatter(%p0, %idx, %u),
+            update_window_dims={1}, unique_indices=false
+          ROOT %r = f32[128,64]{1,0} add(%upd, %p0)
+        }
+    """)
+    found, stats = audit_determinism_text(hlo, context="fixture/grad")
+    assert "UL401" in rules_of(found)
+    assert stats["scatter"] == 1 and stats["scatter_whitelisted"] == 0
+    assert any("fixture/grad" in f.location for f in found)
+
+
+def test_ul401_silent_on_unique_indices_scatter():
+    hlo = (
+        "  %upd = f32[128,64] scatter(%p0, %idx, %u), "
+        "unique_indices=true, to_apply=%add\n"
+    )
+    found, stats = audit_determinism_text(hlo, context="s")
+    assert found == []
+    assert stats["scatter_unique"] == 1
+
+
+def test_ul401_whitelist_admits_slot_mapping_scatter():
+    # the known-safe shape: KV writes routed by slot_mapping are
+    # collision-free by construction even when the compiler can't
+    # prove unique_indices
+    hlo = (
+        '  %w = f32[64,8,16] scatter(%pages, %slots, %kv), '
+        'metadata={op_name="serve/kv_cache/slot_mapping_write"}\n'
+    )
+    found, stats = audit_determinism_text(hlo, context="s")
+    assert found == []
+    assert stats["scatter_whitelisted"] == 1
+    # without the whitelist the same line is a finding
+    found, _ = audit_determinism_text(hlo, context="s", whitelist=())
+    assert "UL401" in rules_of(found)
+
+
+def test_ul401_fires_on_unstable_sort():
+    hlo = "  %s = (f32[8,97], s32[8,97]) sort(%logits, %iota), dimensions={1}\n"
+    found, stats = audit_determinism_text(hlo, context="s")
+    assert "UL401" in rules_of(found)
+    assert stats["sort"] == 1 and stats["sort_stable"] == 0
+
+
+def test_ul401_silent_on_stable_sort():
+    hlo = (
+        "  %s = (f32[8,97], s32[8,97]) sort(%logits, %iota), "
+        "dimensions={1}, is_stable=true\n"
+    )
+    found, stats = audit_determinism_text(hlo, context="s")
+    assert found == []
+    assert stats["sort_stable"] == 1
+
+
+def test_ul401_fires_on_non_threefry_rng():
+    hlo = (
+        "  %r = (u64[2], u32[8,128]) rng-bit-generator(u64[2] %state), "
+        "algorithm=rng_philox\n"
+    )
+    found, _ = audit_determinism_text(hlo, context="s")
+    assert "UL401" in rules_of(found)
+    # threefry is counter-based and bit-reproducible: silent
+    ok = (
+        "  %r = (u64[2], u32[8,128]) rng-bit-generator(u64[2] %state), "
+        "algorithm=rng_three_fry\n"
+    )
+    found, stats = audit_determinism_text(ok, context="s")
+    assert found == []
+    assert stats["rng"] == 1
+
+
+def test_ul401_fires_on_stateful_rng():
+    hlo = "  %r = f32[8] rng(%lo, %hi), distribution=rng_uniform\n"
+    found, _ = audit_determinism_text(hlo, context="s")
+    assert "UL401" in rules_of(found)
+
+
+# ---------------------------------------------------------------------
+# UL402 program identity
+# ---------------------------------------------------------------------
+
+def test_ul402_silent_on_identical_text():
+    text = "HloModule m\nENTRY main { ROOT %r = f32[] add(%a, %b) }\n"
+    found, stats = audit_program_identity(text, text, context="s")
+    assert found == []
+    assert stats["identical"] is True
+    assert stats["program_bytes"] == len(text)
+
+
+def test_ul402_names_first_differing_line():
+    a = "HloModule m\n%x = f32[] add(%a, %b)\n%y = f32[] mul(%x, %x)\n"
+    b = "HloModule m\n%x = f32[] add(%b, %a)\n%y = f32[] mul(%x, %x)\n"
+    found, stats = audit_program_identity(a, b, context="s")
+    assert rules_of(found) == {"UL402"}
+    assert stats["identical"] is False
+    assert stats["first_diff_line"] == 2
+    assert "add(%a, %b)" in found[0].message
+
+
+@pytest.mark.slow
+def test_ul402_double_lower_identity_on_dp_mesh():
+    # the property the committed scenarios rely on, demonstrated on a
+    # real sharded program: two independent lower+compile cycles of
+    # the same function in one process emit byte-identical text
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sh = NamedSharding(mesh, P("dp", None))
+
+    def step(x, w):
+        return jnp.tanh(x @ w).sum(axis=-1)
+
+    x = jax.ShapeDtypeStruct((64, 32), jnp.float32, sharding=sh)
+    w = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    texts = [
+        jax.jit(step).lower(x, w).compile().as_text() for _ in range(2)
+    ]
+    found, stats = audit_program_identity(texts[0], texts[1], context="dp")
+    assert found == [], [f.render() for f in found]
+    assert stats["identical"] is True and stats["program_bytes"] > 0
+
+
+# ---------------------------------------------------------------------
+# UL403 host planning-code audit (AST fixtures)
+# ---------------------------------------------------------------------
+
+def test_ul403_fires_on_unsorted_set_iteration():
+    found = audit_planning_source(textwrap.dedent("""\
+        def plan(rows):
+            live = {r.seq_id for r in rows}
+            for sid in live:
+                assign(sid)
+    """), "serve/scheduler.py")
+    assert rules_of(found) == {"UL403"}
+    assert "set-iteration" in found[0].name
+
+
+def test_ul403_silent_on_sorted_set_iteration():
+    found = audit_planning_source(textwrap.dedent("""\
+        def plan(rows):
+            live = {r.seq_id for r in rows}
+            for sid in sorted(live):
+                assign(sid)
+            order = [s for s in sorted(live | {0})]
+    """), "serve/scheduler.py")
+    assert found == []
+
+
+def test_ul403_fires_on_salted_hash():
+    found = audit_planning_source(textwrap.dedent("""\
+        def route(key, n):
+            return hash(key) % n
+    """), "fleet/router.py")
+    assert rules_of(found) == {"UL403"}
+    assert "salted-hash" in found[0].name
+
+
+def test_ul403_fires_on_id_in_ordering():
+    found = audit_planning_source(textwrap.dedent("""\
+        def tiebreak(a, b):
+            return min(a, b, key=lambda s: id(s))
+    """), "serve/kv_pool.py")
+    assert rules_of(found) == {"UL403"}
+    assert "id-in-ordering" in found[0].name
+    # membership identity checks are fine: id() only matters when it
+    # feeds an ordering decision
+    found = audit_planning_source(textwrap.dedent("""\
+        def seen(s, pool):
+            return id(s) in pool
+    """), "serve/kv_pool.py")
+    assert found == []
+
+
+def test_ul403_fires_on_wall_clock_and_honors_timing_idiom():
+    found = audit_planning_source(textwrap.dedent("""\
+        import time
+        def admit(row):
+            if time.time() > row.deadline:
+                return False
+            return True
+    """), "serve/scheduler.py")
+    assert rules_of(found) == {"UL403"}
+    assert "wall-clock" in found[0].name
+    # measuring elapsed time (t1 - t0) is not a planning decision
+    found = audit_planning_source(textwrap.dedent("""\
+        import time
+        def trace(row):
+            t0 = time.perf_counter()
+            work(row)
+            return time.perf_counter() - t0
+    """), "serve/scheduler.py")
+    assert found == []
+
+
+def test_ul403_suppression_comment():
+    found = audit_planning_source(textwrap.dedent("""\
+        def route(key, n):
+            return hash(key) % n  # unicore-lint: disable=UL403
+    """), "fleet/router.py")
+    assert found == []
+
+
+def test_ul403_repo_planning_sweep_clean():
+    # satellite 2: the shipped planning modules are Pass-5-clean with
+    # zero suppressions.  A regression here names the exact finding.
+    found, report = audit_planning_modules(_repo_root())
+    assert found == [], "\n".join(f.render() for f in found)
+    assert report["missing"] == []
+    assert len(report["audited"]) == len(PLANNING_MODULES)
+
+
+# ---------------------------------------------------------------------
+# UL117 wall-clock in decision paths (source-lint satellite)
+# ---------------------------------------------------------------------
+
+def _lint_snippet(tmp_path, name, code):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([str(f)])
+
+
+def test_ul117_fires_on_wall_clock_decision(tmp_path):
+    found = _lint_snippet(tmp_path, "my_scheduler.py", """
+        import time
+        def admit(row, deadline):
+            return time.monotonic() < deadline
+    """)
+    assert "UL117" in rules_of(found)
+
+
+def test_ul117_silent_on_timing_and_injectable_clock(tmp_path):
+    found = _lint_snippet(tmp_path, "my_scheduler.py", """
+        import time
+        def probe(clock=None):
+            clock = clock or time.monotonic
+            t0 = time.perf_counter()
+            work()
+            elapsed = time.perf_counter() - t0
+            return clock(), elapsed
+    """)
+    assert "UL117" not in rules_of(found)
+
+
+def test_ul117_scope_and_suppression(tmp_path):
+    # non-decision files are out of scope entirely
+    found = _lint_snippet(tmp_path, "data_reader.py", """
+        import time
+        def shard(key):
+            return time.time()
+    """)
+    assert "UL117" not in rules_of(found)
+    found = _lint_snippet(tmp_path, "my_router.py", """
+        import time
+        def pick(ring):
+            return ring[int(time.time())]  # unicore-lint: disable=UL117
+    """)
+    assert "UL117" not in rules_of(found)
+
+
+def test_ul117_repo_decision_paths_clean():
+    import os
+
+    from unicore_tpu.analysis.cli import DEFAULT_LINT_ROOTS
+    from unicore_tpu.analysis.findings import load_baseline, split_baselined
+
+    root = _repo_root()
+    roots = [os.path.join(root, d) for d in DEFAULT_LINT_ROOTS]
+    findings = [
+        f for f in lint_paths(roots, rel_to=root) if f.rule == "UL117"
+    ]
+    fps = load_baseline(os.path.join(root, "tools", "lint_baseline.json"))
+    new, _ = split_baselined(findings, fps)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+# ---------------------------------------------------------------------
+# runtime harness: bit-compare + digest-stream bisector
+# ---------------------------------------------------------------------
+
+def _harness():
+    import importlib.util
+    import os
+
+    path = os.path.join(_repo_root(), "tools", "unicore_determinism.py")
+    spec = importlib.util.spec_from_file_location(
+        "unicore_determinism", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bitwise_compare_is_nan_safe_and_names_leaves():
+    ud = _harness()
+    a = {"w": np.array([1.0, np.nan], np.float32),
+         "b": np.zeros(4, np.int32)}
+    b = {"w": np.array([1.0, np.nan], np.float32),
+         "b": np.zeros(4, np.int32)}
+    mism, nbytes, leaves = ud.bitwise_compare(a, b)
+    assert mism == [] and leaves == 2 and nbytes == 8 + 16
+    b["w"] = np.array([1.0, 2.0], np.float32)
+    mism, _, _ = ud.bitwise_compare(a, b)
+    assert len(mism) == 1 and "w" in mism[0][0]
+
+
+def test_double_run_bit_exact_on_healthy_jitted_step():
+    ud = _harness()
+
+    @jax.jit
+    def step(w, x):
+        h = jnp.tanh(x @ w)
+        return {"loss": (h ** 2).sum(), "grad_ish": h.T @ x}
+
+    rng = np.random.RandomState(3)
+    args = (rng.randn(16, 8).astype(np.float32),
+            rng.randn(32, 16).astype(np.float32))
+    outs, ms = ud.double_run(step, args, runs=2)
+    mism, nbytes, leaves = ud.bitwise_compare(outs[0], outs[1])
+    assert mism == [] and leaves == 2 and nbytes > 0
+    assert len(ms) == 2
+
+
+def test_bisector_localizes_injected_divergence():
+    ud = _harness()
+    counter = {"n": 0}
+
+    def drift(v):
+        # trace-time-gated: pure only in name — each host execution
+        # returns a different value, modeling an impure callback
+        counter["n"] += 1
+        return (v + np.float32(counter["n"])).astype(np.float32)
+
+    def noisy(x):
+        y = jnp.sin(x)          # eqn 0: deterministic prefix
+        z = jax.pure_callback(
+            drift, jax.ShapeDtypeStruct(x.shape, jnp.float32), y
+        )
+        return jnp.sum(z * 2.0)
+
+    x = np.ones((4, 4), np.float32)
+    fd = ud.first_divergence(jax.make_jaxpr(noisy)(x), [x])
+    assert fd is not None
+    assert "callback" in fd["primitive"]
+    # the deterministic sin prefix must NOT be blamed
+    assert fd["eqn_index"] > 0
+
+
+def test_bisector_returns_none_on_deterministic_jaxpr():
+    ud = _harness()
+
+    def clean(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = np.ones((8, 4), np.float32)
+    w = np.ones((4, 4), np.float32)
+    assert ud.first_divergence(jax.make_jaxpr(clean)(x, w), [x, w]) is None
+
+
+def test_digest_stream_rejects_arity_mismatch():
+    ud = _harness()
+
+    def f(x):
+        return x + 1.0
+
+    closed = jax.make_jaxpr(f)(np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="leaves"):
+        ud.digest_stream(closed, [])
+
+
+@pytest.mark.slow
+def test_harness_serve_surface_bit_exact():
+    # end-to-end: capture a real ragged dispatch from the demo engine
+    # and double-run it (the CI smoke runs the train surface too; here
+    # we keep tier-"slow" wall time to the cheap engine)
+    ud = _harness()
+    report = ud.run_serve(runs=2)
+    assert report["deterministic"] is True, report
+    assert report["leaves"] >= 3 and report["bytes_compared"] > 0

@@ -13,6 +13,13 @@ Runs all passes and reports machine-readable JSON plus human text:
                            compiled modules' SCHEDULED text and audit
                            collective/compute overlap (UL301-UL303)
                            against the same budget file
+  Pass 5 (determinism)     --pass5 [--pass5-serve]: audit the same
+                           compiled modules for nondeterministic
+                           execution signatures (UL401), re-compile
+                           each scenario and diff the program texts
+                           byte-exactly (UL402), and AST-audit the
+                           host planning modules that feed device
+                           programs (UL403)
 
 Exit code 0 when no findings outside the baseline, 1 otherwise.  CI
 pins the baseline (``tools/lint_baseline.json``) so only NEW findings
@@ -83,7 +90,8 @@ def build_parser():
         help="fail when the baseline contains suppressions that no "
              "longer fire (baseline rot); scoped to the rule families "
              "this invocation runs (trace UL0xx, lint UL1xx, pass-3 "
-             "UL2xx, pass-4 UL3xx), so a partial run never false-flags "
+             "UL2xx, pass-4 UL3xx, pass-5 UL4xx), so a partial run "
+             "never false-flags "
              "entries it could not have re-fired; also fails on budget "
              "rot — comms_baseline.json entries for scenarios that no "
              "longer exist in scenarios.py",
@@ -114,6 +122,23 @@ def build_parser():
         "--pass4-serve", action="store_true",
         help="Pass 4 over the demo ServeEngine's ragged-step "
              "executables (shares compiles with --pass3-serve)",
+    )
+    p.add_argument(
+        "--pass5", action="store_true",
+        help="Pass 5: audit the --config train step's optimized HLO "
+             "per mesh variant for nondeterministic execution "
+             "signatures (UL401), re-compile each variant and diff "
+             "the program texts byte-exactly (UL402), and AST-audit "
+             "the planning modules (UL403); shares its first compile "
+             "with --pass3/--pass4, pays one extra compile per "
+             "variant for the identity diff",
+    )
+    p.add_argument(
+        "--pass5-serve", action="store_true",
+        help="Pass 5 over the demo ServeEngine's ragged-step "
+             "executables: UL401 + the UL402 re-trace/re-compile "
+             "identity diff (shares compiles with --pass3-serve), "
+             "plus the UL403 planning audit",
     )
     p.add_argument(
         "--pass3-variants", default=None, metavar="CSV",
@@ -186,6 +211,7 @@ def main(argv=None):
     needs_jax = (
         (args.config and not args.no_trace) or args.pass3
         or args.pass3_serve or args.pass4 or args.pass4_serve
+        or args.pass5 or args.pass5_serve
         or args.fused_head_audit
     )
     if needs_jax and args.cpu_devices:
@@ -238,20 +264,24 @@ def main(argv=None):
             )
 
     pass4_report = None
+    pass5_report = None
     budget_path = args.budget_file or os.path.join(
         anchor, os.path.join("tools", "comms_baseline.json")
     )
-    if args.pass3 or args.pass3_serve or args.pass4 or args.pass4_serve:
+    if (args.pass3 or args.pass3_serve or args.pass4 or args.pass4_serve
+            or args.pass5 or args.pass5_serve):
         from unicore_tpu.analysis import hlo_audit
 
         if args.pass3 or args.pass3_serve:
             pass3_report = {"budget_file": budget_path, "scenarios": []}
         if args.pass4 or args.pass4_serve:
             pass4_report = {"budget_file": budget_path, "scenarios": []}
-        if args.pass3 or args.pass4:
+        if args.pass5 or args.pass5_serve:
+            pass5_report = {"scenarios": []}
+        if args.pass3 or args.pass4 or args.pass5:
             if not args.config:
-                print("unicore-lint: error: --pass3/--pass4 need "
-                      "--config", file=sys.stderr)
+                print("unicore-lint: error: --pass3/--pass4/--pass5 "
+                      "need --config", file=sys.stderr)
                 return 2
             from unicore_tpu.analysis.scenarios import (
                 audit_bert_config_pass3,
@@ -265,6 +295,7 @@ def main(argv=None):
                 budget_path=budget_path,
                 update_budgets=args.update_budgets, log=log,
                 pass3=args.pass3, schedule=args.pass4,
+                determinism=args.pass5,
             )
             findings.extend(got)
             if args.pass3:
@@ -275,7 +306,11 @@ def main(argv=None):
                 pass4_report["scenarios"].extend(
                     rep["schedule_scenarios"]
                 )
-        if args.pass3_serve or args.pass4_serve:
+            if args.pass5:
+                pass5_report["scenarios"].extend(
+                    rep["determinism_scenarios"]
+                )
+        if args.pass3_serve or args.pass4_serve or args.pass5_serve:
             from unicore_tpu.analysis.scenarios import audit_serve_demo
 
             got, rep = audit_serve_demo(
@@ -283,6 +318,7 @@ def main(argv=None):
                 update_budgets=args.update_budgets,
                 thresholds=thresholds, log=log,
                 pass3=args.pass3_serve, schedule=args.pass4_serve,
+                determinism=args.pass5_serve,
             )
             findings.extend(got)
             if args.pass3_serve:
@@ -295,6 +331,23 @@ def main(argv=None):
                 pass4_report["scenarios"].extend(
                     rep["schedule_scenarios"]
                 )
+            if args.pass5_serve:
+                pass5_report["scenarios"].extend(
+                    rep["determinism_scenarios"]
+                )
+        if args.pass5 or args.pass5_serve:
+            # UL403 runs once per invocation, not per scenario: the
+            # planning modules are the same host code whichever device
+            # programs they feed
+            from unicore_tpu.analysis.determinism_audit import (
+                audit_planning_modules,
+            )
+
+            got, planning = audit_planning_modules(anchor)
+            findings.extend(got)
+            pass5_report["planning"] = planning
+            log(f"pass5: planning audit over "
+                f"{len(planning['audited'])} module(s)")
         if (args.update_budgets and args.pass3 and args.pass3_serve
                 and not args.pass3_variants
                 and pass3_report.get("fingerprint")):
@@ -359,6 +412,8 @@ def main(argv=None):
             ran.add("UL2")
         if args.pass4 or args.pass4_serve:
             ran.add("UL3")
+        if args.pass5 or args.pass5_serve:
+            ran.add("UL4")
         stale = [
             e for e in stale_baseline_entries(baseline_path, findings)
             if str(e.get("rule", ""))[:3] in ran
@@ -393,6 +448,8 @@ def main(argv=None):
         extra["pass3"] = pass3_report
     if pass4_report is not None:
         extra["pass4"] = pass4_report
+    if pass5_report is not None:
+        extra["pass5"] = pass5_report
     if fused_head_report is not None:
         extra["fused_head_audit"] = fused_head_report
     if stale:

@@ -1,0 +1,500 @@
+"""Pass 5: determinism audit over compiled programs and planning code.
+
+Every correctness oracle this repo ships — chaos replay token identity,
+failover adoption, ZeRO-1 vs dp trajectory equality, packed-vs-padded
+parity — reduces to "bit-exact vs oracle".  Pass 5 certifies the three
+layers that equality stands on, the way Pass 3 gated collective bytes
+and Pass 4 gated overlap:
+
+- UL401 nondeterministic-hlo: the optimized HLO of every Pass-3
+  scenario is walked for execution-order-sensitive signatures:
+
+  * ``scatter`` / ``select-and-scatter`` without ``unique_indices=true``
+    — colliding float accumulations are applied in an unspecified order
+    (GPU atomics famously, but the contract is backend-unspecified),
+    so two runs of the same program may differ in the last ulp.  The
+    serve KV slot-mapping writes are collision-free by construction
+    (one row owns each slot); shapes proven safe that way live in the
+    structural whitelist, matched against the full instruction line so
+    both instruction names and ``op_name=`` metadata can sanction.
+  * ``sort`` without ``is_stable=true`` — ties break in backend order;
+    top-k over logits with duplicate values then returns
+    backend-dependent indices, which changes SAMPLED TOKENS.
+  * ``rng-bit-generator`` with an algorithm other than threefry, the
+    stateful ``rng-get-and-update-state``, and the legacy ``rng`` op —
+    anything outside the counter-based threefry idiom (which lowers to
+    pure arithmetic and usually leaves NO rng op at all) ties random
+    bits to execution order or hidden device state.
+
+  Each finding carries the offending instruction line as evidence, the
+  UL301 style.
+
+- UL402 program-identity: each scenario is re-lowered and re-compiled
+  a SECOND time in the same process and the two program texts diffed
+  byte-exactly.  Embedded nondeterminism — timestamps, object ids,
+  dict-order-dependent constant pools, unstable fusion naming — shows
+  up as a first-differing-line finding.  This generalizes the CI
+  "double-run budget-clean" gate from budget-equality to
+  program-identity: not just the same collective bytes, the same
+  program.  Measured on this repo's scenarios the texts are
+  byte-identical (serve ragged/decode ~310-420 KB, bert/dp ~4.6 MB),
+  so ``DEFAULT_UL402_NORMALIZE`` ships empty; if a toolchain bump
+  introduces benign noise, add a (pattern, replacement) pair there
+  WITH a comment naming the noise rather than weakening the gate.
+
+- UL403 nondeterministic-planning: an AST pass over the host planning
+  modules that feed device programs (scheduler row planning,
+  ``comm_bucket_assignment``, kv_pool chain matching, fleet
+  ring/routing, rollout gates — ``PLANNING_MODULES``).  Flagged:
+
+  * iteration over a ``set``/``frozenset`` without ``sorted()`` — set
+    order is salted per process, so two replicas derive different
+    plans from identical state (dict iteration is insertion-ordered by
+    language guarantee and is NOT flagged);
+  * builtin ``hash()`` anywhere — salted per process since PEP 456;
+    the sanctioned shape is the keyed blake2b digest
+    (``fleet/ring.py`` ``stable_hash``, kv_pool ``_page_digest``);
+  * ``id()`` in an ORDERING context (a sort key, arithmetic, an
+    index) — allocation-order dependent; ``id()`` for identity-set
+    membership is fine and not flagged;
+  * wall-clock reads outside the injectable-clock idiom — same
+    definition as source_lint's UL117, shared constants, same
+    recognized-clean timing shapes.
+
+  Planning modules are named EXPLICITLY: a rename that silently drops
+  a module from the audit is itself a finding (planning-audit-rot).
+
+Runtime side: ``tools/unicore_determinism.py`` replays captured inputs
+through the jitted train and serve steps twice and bit-compares every
+output leaf; on divergence it re-executes the jaxpr primitive by
+primitive and names the first one whose output digests differ.
+
+The XLA:CPU caveat, stated honestly.  The CI legs run on XLA:CPU,
+where scatter and reductions execute serialized and deterministic — a
+double run passing there does NOT prove a GPU run with atomics would.
+That is exactly why UL401 is a STRUCTURAL tripwire (the signature is
+flagged before any backend makes it observable), while the double-run
+harness certifies what CPU can certify: the program is free of
+embedded run-to-run state (RNG misuse, host callbacks smuggling
+wall-clock or iteration-order into the step) and the compile pipeline
+itself is reproducible (UL402).
+
+Suppression: UL403 honors the same inline
+``# unicore-lint: disable=UL403`` comment as Pass 2; UL401/UL402 carry
+fingerprints, so accepted findings go in ``tools/lint_baseline.json``.
+"""
+
+import ast
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from unicore_tpu.analysis.findings import Finding
+from unicore_tpu.analysis.source_lint import (
+    _SUPPRESS_RE,
+    _UL117_DT_FNS,
+    _UL117_TIME_FNS,
+    _UL117_TIMING_NAME_RE,
+    _attr_chain,
+)
+
+# one optimized-HLO instruction: "  %name = shape op(...)" (tuple
+# shapes parenthesized); the FULL line is kept for attribute checks
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+(?P<op>[a-z][a-z0-9\-]*)\("
+)
+
+# UL401: ops whose float accumulation order is unspecified when
+# indices/windows collide
+_SCATTER_OPS = {"scatter", "select-and-scatter"}
+# UL401: rng ops outside the pure-arithmetic threefry lowering
+_STATEFUL_RNG_OPS = {"rng", "rng-get-and-update-state"}
+
+# UL401 structural whitelist: regexes searched against the FULL
+# instruction line (instruction names AND op_name= metadata).  The
+# serve KV slot-mapping write is collision-free by construction — the
+# row planner assigns each (page, offset) slot to exactly one row per
+# dispatch (serve/engine.py _dispatch), so accumulation order cannot
+# matter.  Nothing else is sanctioned; the committed scenarios compile
+# to ZERO scatter ops today (the KV update lowers to
+# dynamic-update-slice), so this list exists for the day a lowering
+# change resurrects one.
+DEFAULT_UL401_WHITELIST: Tuple[str, ...] = (
+    r"kv[-_/.]?cache",
+    r"slot[-_/.]?mapping",
+)
+
+# UL402: (pattern, replacement) pairs applied to both texts before the
+# byte-exact diff.  EMPTY on purpose — double compiles are
+# byte-identical on every committed scenario; see module docstring
+# before adding anything here.
+DEFAULT_UL402_NORMALIZE: Tuple[Tuple[str, str], ...] = ()
+
+# UL403 scope: host planning code whose outputs feed device programs
+# or traffic placement.  Explicit, not discovered — a silently dropped
+# module is a finding (planning-audit-rot), not a silently shrunk
+# audit.
+PLANNING_MODULES: Tuple[str, ...] = (
+    os.path.join("unicore_tpu", "serve", "scheduler.py"),
+    os.path.join("unicore_tpu", "serve", "engine.py"),
+    os.path.join("unicore_tpu", "serve", "kv_pool.py"),
+    os.path.join("unicore_tpu", "distributed", "utils.py"),
+    os.path.join("unicore_tpu", "fleet", "ring.py"),
+    os.path.join("unicore_tpu", "fleet", "router.py"),
+    os.path.join("unicore_tpu", "fleet", "health.py"),
+    os.path.join("unicore_tpu", "deploy", "rollout.py"),
+)
+
+
+# ----------------------------------------------------------------------
+# UL401: nondeterministic execution signatures in optimized HLO
+# ----------------------------------------------------------------------
+
+def audit_determinism_text(hlo_text, *, context,
+                           whitelist=DEFAULT_UL401_WHITELIST):
+    """UL401 over one compiled module's text.  Returns
+    ``(findings, stats)``; stats count what was seen so the report (and
+    its tests) can tell "clean" from "nothing audited"."""
+    pats = [re.compile(p, re.IGNORECASE) for p in whitelist]
+    findings = []
+    stats = {"scatter": 0, "scatter_unique": 0, "scatter_whitelisted": 0,
+             "sort": 0, "sort_stable": 0, "rng": 0}
+    for raw in hlo_text.splitlines():
+        m = _INSTR_RE.match(raw)
+        if not m:
+            continue
+        op, line = m.group("op"), raw.strip()
+        evidence = line[:200]
+        if op in _SCATTER_OPS:
+            stats["scatter"] += 1
+            if "unique_indices=true" in line:
+                stats["scatter_unique"] += 1
+            elif any(p.search(line) for p in pats):
+                stats["scatter_whitelisted"] += 1
+            else:
+                findings.append(Finding(
+                    "UL401", "nondeterministic-scatter", "error",
+                    f"hlo:{context}",
+                    f"{op} %{m.group('name')} without unique_indices="
+                    f"true and outside the slot-mapping whitelist: "
+                    f"colliding float accumulations apply in an "
+                    f"unspecified order, so two runs may differ in the "
+                    f"last ulp | {evidence}",
+                ))
+        elif op == "sort":
+            stats["sort"] += 1
+            if "is_stable=true" in line:
+                stats["sort_stable"] += 1
+            else:
+                findings.append(Finding(
+                    "UL401", "unstable-sort", "error",
+                    f"hlo:{context}",
+                    f"sort %{m.group('name')} without is_stable=true: "
+                    f"ties break in backend order — top-k over logits "
+                    f"with duplicate values returns backend-dependent "
+                    f"indices and changes sampled tokens | {evidence}",
+                ))
+        elif op == "rng-bit-generator":
+            stats["rng"] += 1
+            if "rng_three_fry" not in line:
+                findings.append(Finding(
+                    "UL401", "non-threefry-rng", "error",
+                    f"hlo:{context}",
+                    f"rng-bit-generator %{m.group('name')} outside the "
+                    f"threefry counter-hash idiom: random bits depend "
+                    f"on backend algorithm/state instead of the pure "
+                    f"key arithmetic the replay oracles assume | "
+                    f"{evidence}",
+                ))
+        elif op in _STATEFUL_RNG_OPS:
+            stats["rng"] += 1
+            findings.append(Finding(
+                "UL401", "stateful-rng", "error",
+                f"hlo:{context}",
+                f"{op} %{m.group('name')}: hidden device RNG state "
+                f"advances per execution, so an identical-input replay "
+                f"draws different bits | {evidence}",
+            ))
+    return findings, stats
+
+
+def audit_compiled_determinism(compiled, *, context, **kwargs):
+    """UL401 over a ``lowered.compile()`` artifact."""
+    return audit_determinism_text(
+        compiled.as_text(), context=context, **kwargs
+    )
+
+
+# ----------------------------------------------------------------------
+# UL402: compile-twice program identity
+# ----------------------------------------------------------------------
+
+def audit_program_identity(text_a, text_b, *, context,
+                           normalize=DEFAULT_UL402_NORMALIZE):
+    """UL402: byte-exact diff of two compiles of the SAME scenario in
+    one process.  Returns ``(findings, stats)``; on a mismatch the
+    finding names the first differing line of both texts."""
+    for pat, repl in normalize:
+        rx = re.compile(pat)
+        text_a = rx.sub(repl, text_a)
+        text_b = rx.sub(repl, text_b)
+    stats = {"identical": text_a == text_b, "program_bytes": len(text_a)}
+    if stats["identical"]:
+        return [], stats
+    la, lb = text_a.splitlines(), text_b.splitlines()
+    idx = next(
+        (i for i, (a, b) in enumerate(zip(la, lb)) if a != b),
+        min(len(la), len(lb)),
+    )
+    a = la[idx].strip()[:150] if idx < len(la) else "<end of program>"
+    b = lb[idx].strip()[:150] if idx < len(lb) else "<end of program>"
+    stats["first_diff_line"] = idx + 1
+    return [Finding(
+        "UL402", "program-identity", "error", f"hlo:{context}",
+        f"re-lowering and re-compiling produced a different program "
+        f"(first diff at line {idx + 1} of {len(la)}/{len(lb)}): the "
+        f"compile pipeline embeds run-varying state (timestamp, object "
+        f"id, or iteration-order-dependent constant pool) | first: "
+        f"{a!r} | second: {b!r}",
+    )], stats
+
+
+# ----------------------------------------------------------------------
+# UL403: nondeterminism in host planning code
+# ----------------------------------------------------------------------
+
+_SET_CTORS = {"set", "frozenset"}
+_ORDERING_CALLS = {"sorted", "min", "max"}
+_SEQ_PASSTHROUGH = {"list", "tuple", "enumerate", "reversed"}
+
+
+class _PlanningVisitor(ast.NodeVisitor):
+    """UL403 over one planning module."""
+
+    def __init__(self, path, source):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings = []
+        self._tree = ast.parse(source, filename=path)
+        self.time_aliases = {"time"}
+        self.datetime_aliases = {"datetime", "date"}
+        self.clock_bare_names = set()
+        self._collect_imports()
+        # names bound (anywhere in the module) from a set expression —
+        # a scope-blind heuristic, which is the right trade for lint:
+        # a false merge across functions still names a real set
+        self.set_names = set()
+        for node in ast.walk(self._tree):
+            if (isinstance(node, ast.Assign)
+                    and self._is_set_expr(node.value, _seed=True)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.set_names.add(t.id)
+        self._parents = {}
+        for parent in ast.walk(self._tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+
+    def _collect_imports(self):
+        for node in ast.walk(self._tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        self.time_aliases.add(alias.asname or alias.name)
+                    elif alias.name == "datetime":
+                        self.datetime_aliases.add(
+                            alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for alias in node.names:
+                        if alias.name in _UL117_TIME_FNS:
+                            self.clock_bare_names.add(
+                                alias.asname or alias.name)
+                elif node.module == "datetime":
+                    for alias in node.names:
+                        if alias.name in ("datetime", "date"):
+                            self.datetime_aliases.add(
+                                alias.asname or alias.name)
+
+    # -- emit ----------------------------------------------------------
+
+    def emit(self, name, node, message):
+        lineno = node.lineno
+        if 1 <= lineno <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[lineno - 1])
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                if "UL403" in ids or "all" in ids:
+                    return
+        self.findings.append(Finding(
+            "UL403", name, "error", f"{self.path}:{lineno}", message
+        ))
+
+    # -- helpers -------------------------------------------------------
+
+    def _is_set_expr(self, node, _seed=False):
+        """``node`` evaluates to a set (or a sequence built straight
+        from one — ``list(set(...))`` preserves the salted order)."""
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in _SET_CTORS:
+                return True
+            if (node.func.id in _SEQ_PASSTHROUGH and node.args
+                    and self._is_set_expr(node.args[0], _seed=_seed)):
+                return True
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            # set algebra: members | extra, live - dead
+            return (self._is_set_expr(node.left, _seed=_seed)
+                    or self._is_set_expr(node.right, _seed=_seed))
+        if not _seed and isinstance(node, ast.Name):
+            return node.id in self.set_names
+        return False
+
+    def _wall_clock_call(self, node):
+        chain = _attr_chain(node.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        tail = parts[-1]
+        if len(parts) == 1:
+            return chain if tail in self.clock_bare_names else None
+        if tail in _UL117_TIME_FNS and parts[-2] in self.time_aliases:
+            return chain
+        if tail in _UL117_DT_FNS and any(
+                p in self.datetime_aliases for p in parts[:-1]):
+            return chain
+        return None
+
+    def _timing_clean(self, node):
+        """Same recognized-clean shapes as UL117: under a ``-`` up to
+        the statement, or a timing-named Assign target."""
+        cur = node
+        while True:
+            p = self._parents.get(id(cur))
+            if p is None or isinstance(p, ast.stmt):
+                if (isinstance(p, ast.Assign) and p.value is node
+                        and len(p.targets) == 1):
+                    t = p.targets[0]
+                    tname = (t.id if isinstance(t, ast.Name)
+                             else t.attr if isinstance(t, ast.Attribute)
+                             else "")
+                    return bool(_UL117_TIMING_NAME_RE.search(tname))
+                return False
+            if isinstance(p, ast.BinOp) and isinstance(p.op, ast.Sub):
+                return True
+            cur = p
+
+    def _in_ordering_context(self, node):
+        """``node`` feeds an ordering decision: a sorted/min/max
+        argument (including through a key lambda), arithmetic, or an
+        index.  Membership shapes (``in``, set construction, ``.add``)
+        terminate the walk clean."""
+        cur = node
+        while True:
+            p = self._parents.get(id(cur))
+            if p is None or isinstance(p, ast.stmt):
+                return False
+            if isinstance(p, (ast.BinOp, ast.Subscript)):
+                return True
+            if (isinstance(p, ast.Call)
+                    and isinstance(p.func, ast.Name)
+                    and p.func.id in _ORDERING_CALLS):
+                return True
+            if isinstance(p, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in p.ops):
+                return False
+            if isinstance(p, (ast.Set, ast.SetComp)):
+                return False
+            cur = p
+
+    # -- checks --------------------------------------------------------
+
+    def _check_iter(self, it):
+        if self._is_set_expr(it):
+            self.emit(
+                "unordered-set-iteration", it,
+                "iteration over a set without sorted(): set order is "
+                "salted per process, so two replicas derive DIFFERENT "
+                "plans from identical state — wrap in sorted(...) "
+                "(fleet/ring.py sorts its member set before hashing)",
+            )
+
+    def visit_For(self, node):
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "hash":
+                self.emit(
+                    "salted-hash", node,
+                    "builtin hash() in planning code: salted per "
+                    "process (PEP 456), so replicas disagree and "
+                    "replays diverge — use the keyed blake2b digest "
+                    "shape (fleet/ring.py stable_hash, kv_pool "
+                    "_page_digest)",
+                )
+            elif node.func.id == "id" and self._in_ordering_context(node):
+                self.emit(
+                    "id-in-ordering", node,
+                    "id() feeding an ordering decision: allocation "
+                    "addresses differ across processes and runs — "
+                    "sort/index on a stable key instead (id() for "
+                    "identity-set membership is fine)",
+                )
+        chain = self._wall_clock_call(node)
+        if chain and not self._timing_clean(node):
+            self.emit(
+                "wall-clock-in-planning", node,
+                f"{chain}() in planning code outside the "
+                f"injectable-clock idiom: a plan keyed on the real "
+                f"clock cannot be replayed — take clock=None and read "
+                f"the injected clock (fleet/health.py)",
+            )
+        self.generic_visit(node)
+
+    def run(self):
+        self.visit(self._tree)
+        return self.findings
+
+
+def audit_planning_source(source, path):
+    """UL403 over one module's source (fixture entry point)."""
+    return _PlanningVisitor(path, source).run()
+
+
+def audit_planning_modules(root, modules: Sequence[str] = PLANNING_MODULES):
+    """UL403 over the explicit planning-module set under ``root``.
+    Returns ``(findings, stats)``.  A missing module is planning-audit
+    rot — renames must update ``PLANNING_MODULES``."""
+    findings: List[Finding] = []
+    audited, missing = [], []
+    for rel in modules:
+        full = os.path.join(root, rel)
+        if not os.path.isfile(full):
+            missing.append(rel)
+            findings.append(Finding(
+                "UL403", "planning-audit-rot", "warning", rel,
+                "planning module named in PLANNING_MODULES does not "
+                "exist — a rename silently dropped it from the "
+                "determinism audit; update the list",
+            ))
+            continue
+        with open(full, encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(audit_planning_source(source, rel))
+        audited.append(rel)
+    return findings, {"audited": audited, "missing": missing}

@@ -239,28 +239,35 @@ def compile_variant(example_dir, overrides, devices, *,
 def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                             budget_path=None, update_budgets=False,
                             tolerance=None, log=None,
-                            pass3=True, schedule=False):
-    """Pass-3/Pass-4 compiled-HLO audit over the bert config's mesh
-    variants — ONE compile per variant feeds both passes.
+                            pass3=True, schedule=False,
+                            determinism=False):
+    """Pass-3/Pass-4/Pass-5 compiled-HLO audit over the bert config's
+    mesh variants — ONE compile per variant feeds every pass.
 
     Per variant: compile the real train step; with ``pass3`` extract
     its collectives, run UL201 (fsdp engagement), and check
     UL202/UL203 against the committed budget file; with ``schedule``
     parse the scheduled module text, run UL301/UL303 over the async
     start/done windows, and check the overlap stats against the same
-    budget entries (UL302).  Match groups (``PASS3_MATCH_GROUPS``)
-    then compile their extra members and run UL204 (pass3 only).  With
-    ``update_budgets`` the measured stats refresh the budget entries
-    for the current environment fingerprint BEFORE the budget rules
-    evaluate, so an accepted change leaves the run clean.
+    budget entries (UL302); with ``determinism`` run UL401 over the
+    optimized text, then RE-compile the variant from scratch and diff
+    the two program texts byte-exactly (UL402) — the only pass that
+    pays a second compile, which is exactly its point.  Match groups
+    (``PASS3_MATCH_GROUPS``) then compile their extra members and run
+    UL204 (pass3 only).  With ``update_budgets`` the measured stats
+    refresh the budget entries for the current environment fingerprint
+    BEFORE the budget rules evaluate, so an accepted change leaves the
+    run clean.
 
     Returns (findings, report): report carries the fingerprint,
-    per-scenario Pass-3 stats (``scenarios``), and per-scenario Pass-4
-    schedule stats (``schedule_scenarios``) for the JSON report.
+    per-scenario Pass-3 stats (``scenarios``), per-scenario Pass-4
+    schedule stats (``schedule_scenarios``), and per-scenario Pass-5
+    stats (``determinism_scenarios``) for the JSON report.
     """
     import jax
 
-    from unicore_tpu.analysis import hlo_audit, schedule_audit
+    from unicore_tpu.analysis import (determinism_audit, hlo_audit,
+                                      schedule_audit)
 
     avail = jax.devices()
     if n_devices is None:
@@ -284,6 +291,7 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
     snap = snapshot_globals()
     scenarios_report = []
     schedule_report = []
+    determinism_report = []
     try:
         for name in wanted:
             overrides, min_dev = variant_map[name]
@@ -297,6 +305,8 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                     scenarios_report.append(skip)
                 if schedule:
                     schedule_report.append(dict(skip))
+                if determinism:
+                    determinism_report.append(dict(skip))
                 continue
             ctx = f"bert/{name}"
             if log:
@@ -329,6 +339,25 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                 findings.extend(got)
                 schedule_stats[ctx] = sstats
                 schedule_report.append({"scenario": ctx, **sstats})
+            if determinism:
+                got, dstats = determinism_audit.audit_compiled_determinism(
+                    compiled, context=ctx,
+                )
+                findings.extend(got)
+                if log:
+                    log(f"pass5: re-compiling {ctx} for program "
+                        f"identity")
+                _, _, recompiled = compile_variant(
+                    example_dir, overrides, devices
+                )
+                got, istats = determinism_audit.audit_program_identity(
+                    compiled.as_text(), recompiled.as_text(),
+                    context=ctx,
+                )
+                findings.extend(got)
+                determinism_report.append(
+                    {"scenario": ctx, **dstats, **istats}
+                )
 
         for group_name, members in PASS3_MATCH_GROUPS if pass3 else ():
             # a restricted --pass3-variants run only pays for the match
@@ -395,7 +424,8 @@ def audit_bert_config_pass3(example_dir, *, variants=None, n_devices=None,
                 ctx, sstats, entry, tolerance=tol
             ))
     report = {"fingerprint": fp, "scenarios": scenarios_report,
-              "schedule_scenarios": schedule_report}
+              "schedule_scenarios": schedule_report,
+              "determinism_scenarios": determinism_report}
     return findings, report
 
 
@@ -448,9 +478,11 @@ def build_demo_serve_engine(seed=1):
 
 def audit_serve_demo(*, budget_path=None, update_budgets=False,
                      tolerance=None, thresholds=None, log=None,
-                     engine=None, pass3=True, schedule=False):
-    """Pass 1 + Pass 3 (and/or Pass 4) over the demo ServeEngine's
-    unified ragged jits — one compile per executable feeds every pass.
+                     engine=None, pass3=True, schedule=False,
+                     determinism=False):
+    """Pass 1 + Pass 3 (and/or Pass 4 / Pass 5) over the demo
+    ServeEngine's unified ragged jits — one compile per executable
+    feeds every pass.
 
     The engine's compile surface is CONSTANT since the ragged
     unification: two widths of ONE step function (the pure-decode
@@ -461,9 +493,13 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
     donation/jaxpr-audited, and compiled for the budget rules —
     without executing on device.  With ``schedule`` the scheduled
     module text additionally runs the Pass-4 overlap audit
-    (UL301/UL302/UL303).  Returns (findings, report).
+    (UL301/UL302/UL303).  With ``determinism`` each compiled text runs
+    UL401 and is then re-traced and re-compiled from the SAME engine
+    (``trace_step_fns`` re-traces on every call) for the UL402
+    byte-identity diff.  Returns (findings, report).
     """
-    from unicore_tpu.analysis import hlo_audit, schedule_audit, trace_audit
+    from unicore_tpu.analysis import (determinism_audit, hlo_audit,
+                                      schedule_audit, trace_audit)
     from unicore_tpu.analysis.trace_audit import audit_donation, audit_jaxpr
 
     th = dict(thresholds or {})
@@ -481,14 +517,19 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
     # composition, identical across widths, so width-1 coverage of
     # temp/topk audits the sampling paths without doubling the
     # chunk-width compiles)
-    arts = dict(engine.trace_step_fns(sampling="greedy"))
-    for sampling in ("temp", "topk"):
-        got = engine.trace_step_fns(sampling=sampling, widths=(1,))
-        arts[f"decode-{sampling}"] = got["ragged-w1"]
+    def trace_all():
+        got = dict(engine.trace_step_fns(sampling="greedy"))
+        for sampling in ("temp", "topk"):
+            one = engine.trace_step_fns(sampling=sampling, widths=(1,))
+            got[f"decode-{sampling}"] = one["ragged-w1"]
+        return got
+
+    arts = trace_all()
     scenario_stats = {}
     schedule_stats = {}
     scenarios_report = []
     schedule_report = []
+    determinism_report = []
     for name, art in sorted(arts.items()):
         ctx = f"serve/{name}"
         if log:
@@ -519,6 +560,32 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
             findings.extend(got)
             schedule_stats[ctx] = sstats
             schedule_report.append({"scenario": ctx, **sstats})
+        if determinism:
+            got, dstats = determinism_audit.audit_compiled_determinism(
+                compiled, context=ctx,
+            )
+            findings.extend(got)
+            arts[name]["_pass5"] = {"compiled_text": compiled.as_text(),
+                                    "stats": dstats}
+
+    if determinism:
+        # second trace+lower+compile of every executable, same engine,
+        # same process: the UL402 program-identity diff
+        arts2 = trace_all()
+        for name in sorted(arts):
+            ctx = f"serve/{name}"
+            if log:
+                log(f"pass5: re-compiling {ctx} for program identity")
+            first = arts[name]["_pass5"]
+            got, istats = determinism_audit.audit_program_identity(
+                first["compiled_text"],
+                arts2[name]["lowered"].compile().as_text(),
+                context=ctx,
+            )
+            findings.extend(got)
+            determinism_report.append(
+                {"scenario": ctx, **first["stats"], **istats}
+            )
 
     fp = None
     if budget_path is not None:
@@ -545,7 +612,8 @@ def audit_serve_demo(*, budget_path=None, update_budgets=False,
                 ctx, sstats, entry, tolerance=tol
             ))
     return findings, {"fingerprint": fp, "scenarios": scenarios_report,
-                      "schedule_scenarios": schedule_report}
+                      "schedule_scenarios": schedule_report,
+                      "determinism_scenarios": determinism_report}
 
 
 def audit_fused_head_memory(example_dir, *, variants=None, n_devices=None,

@@ -152,6 +152,24 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   hot-swapped into live traffic.  Train-side code is exempt (its reads
   are guarded by the checkpoint_utils load path itself).
 
+- UL117 wall-clock-in-decision-path: a wall-clock read
+  (``time.time``/``perf_counter``/``monotonic``/``datetime.now``/…)
+  inside a production DECISION module — scheduler/router/health/
+  rollout/tuning dispatch, and everything under ``fleet/`` and
+  ``deploy/`` — outside the injectable-clock idiom those tiers
+  standardize on (``clock=None`` parameter, ``self._clock = clock or
+  time.monotonic``).  A decision keyed on the real clock cannot be
+  replayed: the chaos/failover oracles, the virtual-time fleet traces,
+  and the Pass-5 determinism harness all depend on every admission
+  deadline, health verdict, and rollout gate being a pure function of
+  injected state.  Recognized-clean shapes (never flagged): an elapsed
+  MEASUREMENT — the read sits under a ``-`` (``dt = perf_counter() -
+  t0``, ``stats[...] += perf_counter() - t0``) — and a timing ORIGIN
+  stamp — ``t0 = perf_counter()``, any single target matching
+  ``t``/``t<N>``/``*start*``/``*begin*``/``*origin*``.  Name
+  references (``clock or time.perf_counter``) are defaults for the
+  injectable idiom itself and are not calls, so they never fire.
+
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
 """
@@ -282,6 +300,27 @@ _UL115_SHUTDOWN_METHODS = {"stop", "close", "drain", "shutdown",
 _UL116_NAME_HINTS = ("checkpoint", "ckpt", "manifest")
 
 
+# UL117 (also imported by analysis/determinism_audit.py for UL403 —
+# the rules share one definition of "a wall-clock read"): time-module
+# attributes that read the real clock
+_UL117_TIME_FNS = {
+    "time", "perf_counter", "monotonic", "process_time",
+    "time_ns", "perf_counter_ns", "monotonic_ns", "process_time_ns",
+}
+# UL117: datetime constructors that read the real clock
+_UL117_DT_FNS = {"now", "utcnow", "today"}
+# UL117: an Assign target matching this is a timing ORIGIN stamp
+# (``t0 = perf_counter()``); the paired elapsed read is recognized by
+# its BinOp-Sub shape instead
+_UL117_TIMING_NAME_RE = re.compile(
+    r"(^t\d*$|start|begin|origin)", re.IGNORECASE
+)
+# UL117: basename fragments that mark a module as decision dispatch
+# (fleet/ and deploy/ are in scope wholesale — see _is_decision_file)
+_UL117_DECISION_FRAGS = ("scheduler", "engine", "router", "rollout",
+                         "health", "tuner", "tuning")
+
+
 def _attr_chain(node):
     """'jax.jit' for Attribute(Name('jax'), 'jit'); None when dynamic."""
     parts = []
@@ -295,10 +334,12 @@ def _attr_chain(node):
 
 
 class _ModuleLint(ast.NodeVisitor):
-    def __init__(self, path, source, *, dataset_file, deploy_file, lines):
+    def __init__(self, path, source, *, dataset_file, deploy_file, lines,
+                 decision_file=False):
         self.path = path
         self.dataset_file = dataset_file
         self.deploy_file = deploy_file
+        self.decision_file = decision_file
         self.lines = lines
         self.findings = []
         # alias tracking: import numpy as np / import random as rnd
@@ -308,6 +349,9 @@ class _ModuleLint(ast.NodeVisitor):
         self.jax_aliases = {"jax"}
         self.threading_aliases = {"threading"}
         self.thread_ctors = set()   # bare names: from threading import Thread
+        self.time_aliases = {"time"}
+        self.datetime_aliases = {"datetime", "date"}
+        self.clock_bare_names = set()  # from time import perf_counter
         self.jitted_names = set()
         self._with_seed_depth = 0
         self._step_loop_depth = 0
@@ -317,6 +361,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
         self._collect_zero1_plumbing()
+        self._collect_ul117_clean()
 
     # -- setup ---------------------------------------------------------
 
@@ -335,6 +380,10 @@ class _ModuleLint(ast.NodeVisitor):
                         self.jax_aliases.add(name)
                     elif alias.name == "threading":
                         self.threading_aliases.add(name)
+                    elif alias.name == "time":
+                        self.time_aliases.add(name)
+                    elif alias.name == "datetime":
+                        self.datetime_aliases.add(name)
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "jax":
                     for alias in node.names:
@@ -348,6 +397,18 @@ class _ModuleLint(ast.NodeVisitor):
                             self.thread_ctors.add(
                                 alias.asname or alias.name
                             )
+                elif node.module == "time":
+                    for alias in node.names:
+                        if alias.name in _UL117_TIME_FNS:
+                            self.clock_bare_names.add(
+                                alias.asname or alias.name
+                            )
+                elif node.module == "datetime":
+                    for alias in node.names:
+                        if alias.name in ("datetime", "date"):
+                            self.datetime_aliases.add(
+                                alias.asname or alias.name
+                            )
             elif isinstance(node, ast.Call) and self._is_jax_jit(node.func):
                 if node.args and isinstance(node.args[0], ast.Name):
                     self.jitted_names.add(node.args[0].id)
@@ -358,6 +419,57 @@ class _ModuleLint(ast.NodeVisitor):
             return False
         head, _, tail = chain.rpartition(".")
         return tail == "jit" and (head in self.jax_aliases or head == "")
+
+    def _is_wall_clock(self, func):
+        """``func`` (a Call's func node) reads the real clock: a
+        ``time.*`` attribute, a ``datetime``/``date`` constructor, or a
+        bare name from ``from time import perf_counter``."""
+        chain = _attr_chain(func)
+        if chain is None:
+            return False
+        parts = chain.split(".")
+        tail = parts[-1]
+        if len(parts) == 1:
+            return tail in self.clock_bare_names
+        if tail in _UL117_TIME_FNS and parts[-2] in self.time_aliases:
+            return True
+        return (tail in _UL117_DT_FNS
+                and any(p in self.datetime_aliases for p in parts[:-1]))
+
+    def _collect_ul117_clean(self):
+        """Pre-pass marking wall-clock Call nodes in a recognized-clean
+        shape: under a ``-`` anywhere up to the enclosing statement (an
+        elapsed measurement, including ``+= perf_counter() - t0`` and
+        ``(perf_counter() - t0) / iters``), or the whole value of an
+        Assign to a timing-named target (``t0 = perf_counter()``)."""
+        self._ul117_clean = set()
+        if not self.decision_file:
+            return
+        parents = {}
+        for parent in ast.walk(self._tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+        for node in ast.walk(self._tree):
+            if not (isinstance(node, ast.Call)
+                    and self._is_wall_clock(node.func)):
+                continue
+            cur = node
+            while True:
+                p = parents.get(id(cur))
+                if p is None or isinstance(p, ast.stmt):
+                    if (isinstance(p, ast.Assign) and p.value is node
+                            and len(p.targets) == 1):
+                        t = p.targets[0]
+                        tname = (t.id if isinstance(t, ast.Name)
+                                 else t.attr if isinstance(t, ast.Attribute)
+                                 else "")
+                        if _UL117_TIMING_NAME_RE.search(tname):
+                            self._ul117_clean.add(id(node))
+                    break
+                if isinstance(p, ast.BinOp) and isinstance(p.op, ast.Sub):
+                    self._ul117_clean.add(id(node))
+                    break
+                cur = p
 
     # -- emit ----------------------------------------------------------
 
@@ -1361,7 +1473,29 @@ class _ModuleLint(ast.NodeVisitor):
         self._check_sync_in_step_loop(node)
         self._check_blocking_in_router_loop(node)
         self._check_replicated_optim_init(node)
+        self._check_wall_clock(node)
         self.generic_visit(node)
+
+    # -- UL117 ---------------------------------------------------------
+
+    def _check_wall_clock(self, node):
+        if not self.decision_file:
+            return
+        if not self._is_wall_clock(node.func):
+            return
+        if id(node) in self._ul117_clean:
+            return
+        chain = _attr_chain(node.func) or "<wall clock>"
+        self.emit(
+            "UL117", "wall-clock-in-decision-path", "warning", node,
+            f"{chain}() read in a decision module outside the "
+            f"injectable-clock idiom — a deadline, health verdict, or "
+            f"rollout gate keyed on the real clock cannot be replayed "
+            f"by the chaos/failover oracles or the Pass-5 determinism "
+            f"harness; take a clock=None parameter and read "
+            f"self._clock() (fleet/health.py, serve/engine.py), or use "
+            f"the t0/elapsed measurement shape for pure timing",
+        )
 
     # -- UL115 ---------------------------------------------------------
 
@@ -1593,6 +1727,20 @@ def _is_deploy_file(path):
                for d in ("deploy", "serve", "fleet"))
 
 
+def _is_decision_file(path):
+    """UL117 scope: host modules whose control decisions feed device
+    programs or live traffic — admission/row planning, replica routing,
+    health verdicts, rollout gates, kernel-variant dispatch.  Everything
+    under fleet/ and deploy/ is decision code wholesale; elsewhere the
+    basename names the role."""
+    norm = path.replace(os.sep, "/")
+    if any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+           for d in ("fleet", "deploy")):
+        return True
+    return any(f in os.path.basename(norm)
+               for f in _UL117_DECISION_FRAGS)
+
+
 def lint_file(path, *, rel_to=None):
     with open(path, encoding="utf-8") as fh:
         source = fh.read()
@@ -1603,6 +1751,7 @@ def lint_file(path, *, rel_to=None):
             dataset_file=_is_dataset_file(rel),
             deploy_file=_is_deploy_file(rel),
             lines=source.splitlines(),
+            decision_file=_is_decision_file(rel),
         )
     except SyntaxError as e:
         return [Finding(

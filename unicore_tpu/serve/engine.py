@@ -167,6 +167,11 @@ class ServeEngine:
         # never produces a lowering outside serve_step_widths()
         self.width_fn = self._width_for
         self._step_fns = {}
+        # Pass-5 determinism harness hook: when set, called with
+        # ((width, sampling), args) BEFORE the jitted call consumes
+        # (donates) the pages — tools/unicore_determinism.py captures
+        # host copies here and replays them twice
+        self._input_capture = None
         # one host clock for enqueue stamps, TTFT, deadlines, and the
         # drain timer — injectable so deadline/drain tests are exact
         self._clock = clock or time.perf_counter
@@ -555,6 +560,11 @@ class ServeEngine:
                 poison[b] = self._poison_row(seq)
             args.append(jnp.asarray(poison))
         any_decode = any(r[4] for r in rows)
+        if self._input_capture is not None:
+            # determinism-harness capture: before the call — the jit
+            # donates the pages (argnums 1), so the buffers are gone
+            # the moment it is issued
+            self._input_capture((w, sampling), args)
         t0 = time.perf_counter()
         with self._armed(f"serve/ragged-w{w}"):
             toks, ok, self.pages = self._ragged_step_fn(w, sampling)(*args)

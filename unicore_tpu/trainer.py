@@ -301,6 +301,11 @@ class Trainer:
         self._compiled_train_step = None
         self._compiled_sig = None
         self._memory_analysis = None
+        # Pass-5 determinism harness hook: when set, called with the
+        # exact argument tuple of the next dispatch BEFORE the compiled
+        # call consumes (donates) it — tools/unicore_determinism.py
+        # captures host copies here and replays them twice
+        self._input_capture = None
         self._jit_valid_step = None
         self.total_train_steps = None
         # pipelined stats: keep up to ``stats_lag`` steps' device stats
@@ -1491,6 +1496,13 @@ class Trainer:
             self._preflight_memory_check(compiled)
             self._compiled_train_step = compiled
             self._compiled_sig = sig
+        if self._input_capture is not None:
+            # determinism-harness capture: must run BEFORE the compiled
+            # call — donate_argnums=(0,) invalidates the state buffers
+            # the moment the call is issued
+            self._input_capture(
+                (state, batches, weights, lr, rng, inject)
+            )
         # the watchdog arms around EXECUTION only: --step-timeout is
         # tuned to step time, and a first-step (or resignature) XLA
         # compile legitimately takes minutes — arming it too would
