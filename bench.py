@@ -637,6 +637,70 @@ def _fleet_slo_micros(out):
     return round(agg["shed"] / len(trace), 4)
 
 
+def _autoscale_micros(out):
+    """Elastic autoscaling under the committed traffic-scenario suite
+    (ISSUE 20): every named scenario replays at the committed seed
+    through a 2-replica fleet with the SLO-projection autoscaler
+    attached.  Decisions run on the virtual 2ms step width
+    (``step_time_ms``), so the per-scenario decision counts are
+    bit-deterministic from the seed; the MEASURED number is the
+    autoscaler's host cost per fleet step — the ``on_step`` poll every
+    serving step pays for elasticity."""
+    import time
+
+    from unicore_tpu.fleet.autoscaler import FleetAutoscaler
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import (SCENARIOS, replay_trace,
+                                         scenario_trace)
+
+    def _mk(rid):
+        del rid
+        return _serve_engine(max_waiting=16)[1]
+
+    poll_ns = []
+    scenarios = {}
+    for name in SCENARIOS:
+        engines = {rid: _mk(rid) for rid in ("r0", "r1")}
+        router = FleetRouter(engines, factory=_mk)
+        scaler = router.attach_autoscaler(FleetAutoscaler(
+            router, min_replicas=2, max_replicas=4,
+            high_watermark_ms=24.0, low_watermark_ms=1.0,
+            hysteresis_steps=2, cooldown_steps=8, step_time_ms=2.0))
+        trace = scenario_trace(
+            name, FLEET_TRACE_SEED, num_requests=48, vocab=4096,
+            body_len_clip=(1, 48), max_new_tokens=(4, 12))
+        orig_poll = scaler.on_step
+        peak = [len(engines)]
+
+        def timed_poll(fleet_step, _orig=orig_poll, _peak=peak):
+            t0 = time.perf_counter_ns()
+            _orig(fleet_step)
+            poll_ns.append(time.perf_counter_ns() - t0)
+            _peak[0] = max(_peak[0], len(router.engines))
+
+        scaler.on_step = timed_poll
+        steps = replay_trace(router, trace, step_ms=2.0)
+        desc = scaler.describe()
+        agg = router.fleet_report()["aggregate"]
+        scenarios[name] = {
+            "requests": len(trace), "steps": steps,
+            "scale_ups": desc["scale_ups"],
+            "scale_downs": desc["scale_downs"],
+            "boot_failures": desc["boot_failures"],
+            "peak_replicas": peak[0],
+            "shed": agg["shed"],
+        }
+    out["autoscale_scenarios"] = scenarios
+    out["autoscale_trace_seed"] = FLEET_TRACE_SEED
+    out["autoscale_polls"] = len(poll_ns)
+    # the mean is dominated by the rare poll that BOOTS an engine
+    # (factory + compile); record it beside the typical per-step cost
+    out["autoscale_poll_mean_us"] = round(
+        sum(poll_ns) / max(1, len(poll_ns)) / 1e3, 2)
+    ordered = sorted(poll_ns)
+    return round(ordered[len(ordered) // 2] / 1e3, 2)
+
+
 def _fleet_failover_micros(out):
     """Failover recovery cost (ISSUE 14): a warm 2-replica fleet
     replays the COMMITTED trace (``FLEET_TRACE_SEED``) and replica r0
@@ -1805,6 +1869,11 @@ def _microbench(out):
     _micro_guard(out, "fleet_failover_recovery_ms",
                  lambda: _fleet_failover_micros(out))
 
+    # elastic autoscaling (ISSUE 20): per-step policy poll cost and the
+    # deterministic per-scenario decision counts
+    _micro_guard(out, "autoscale_poll_us",
+                 lambda: _autoscale_micros(out))
+
     # train-to-serve deployment (ISSUE 18): hot-swap stall, canary
     # rollout wall time, and the publish-induced TTFT tail delta
     _micro_guard(out, "publish_swap_stall_ms",
@@ -1968,6 +2037,7 @@ def _cpu_tier_main():
         ("fleet_shed_rate", lambda: _fleet_slo_micros(micro)),
         ("fleet_failover_recovery_ms",
          lambda: _fleet_failover_micros(micro)),
+        ("autoscale_poll_us", lambda: _autoscale_micros(micro)),
         ("publish_swap_stall_ms", lambda: _deploy_micros(micro)),
         ("serve_decode_tokens_per_sec", lambda: _serve_micros(micro)),
         ("serve_warm_prefix_ttft_ms",

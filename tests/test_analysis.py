@@ -2456,3 +2456,114 @@ def test_ul116_repo_sweep_clean():
         if f.rule == "UL116"
     ]
     assert found == [], "\n".join(f.render() for f in found)
+
+
+# ---------------------------------------------------------------------
+# UL118 unbounded-replica-growth (elastic fleet satellite)
+# ---------------------------------------------------------------------
+
+def test_ul118_fires_on_unbounded_boot_shapes(tmp_path):
+    # pressure-retry while loop appending fresh engines: no bound
+    found = _lint_snippet(tmp_path, "grow1.py", """
+        def grow(factory, engines, pressure):
+            while pressure():
+                engines.append(factory(len(engines)))
+    """)
+    assert "UL118" in rules_of(found)
+    # subscript store keyed by a counter, not the loop variable
+    found = _lint_snippet(tmp_path, "grow2.py", """
+        def grow(factory, engines, events):
+            n = 0
+            for ev in events:
+                if ev.hot:
+                    n = n + 1
+                    engines["a%d" % n] = factory(n)
+    """)
+    assert "UL118" in rules_of(found)
+    # the boot laundered through a name before joining the fleet
+    found = _lint_snippet(tmp_path, "grow3.py", """
+        def grow(engine_factory, fleet, ticks):
+            for t in ticks:
+                eng = engine_factory(t.rid)
+                fleet.add(eng)
+    """)
+    assert "UL118" in rules_of(found)
+
+
+def test_ul118_silent_on_replacement_and_scale_gates(tmp_path):
+    # rolling restart's replacement shape: same slot, no growth
+    found = _lint_snippet(tmp_path, "roll.py", """
+        def roll(factory, engines):
+            for rid in sorted(engines):
+                engines[rid] = factory(rid)
+    """)
+    assert "UL118" not in rules_of(found)
+    # max-replicas bound in the loop
+    found = _lint_snippet(tmp_path, "gated1.py", """
+        def grow(factory, engines, pressure, max_replicas):
+            while pressure():
+                if len(engines) >= max_replicas:
+                    break
+                engines.append(factory(len(engines)))
+    """)
+    assert "UL118" not in rules_of(found)
+    # a len() bound is a bound even when the cap name says nothing
+    found = _lint_snippet(tmp_path, "gated1b.py", """
+        def grow(factory, fleet, cap):
+            while len(fleet) < cap:
+                fleet.append(factory("r"))
+    """)
+    assert "UL118" not in rules_of(found)
+    # cooldown gate in the loop
+    found = _lint_snippet(tmp_path, "gated2.py", """
+        def grow(factory, engines, pressure, cooldown_ok):
+            while pressure():
+                if not cooldown_ok():
+                    continue
+                engines.append(factory(len(engines)))
+    """)
+    assert "UL118" not in rules_of(found)
+    # breaker-gated canary boot
+    found = _lint_snippet(tmp_path, "gated3.py", """
+        def grow(factory, engines, pressure, breaker):
+            while pressure():
+                if breaker.ready(0):
+                    engines.append(factory(len(engines)))
+    """)
+    assert "UL118" not in rules_of(found)
+    # a factory result that never joins a collection is a local probe
+    found = _lint_snippet(tmp_path, "probe.py", """
+        def probe(factory, ticks):
+            for t in ticks:
+                eng = factory(t)
+                eng.close()
+    """)
+    assert "UL118" not in rules_of(found)
+
+
+def test_ul118_inline_suppression(tmp_path):
+    found = _lint_snippet(tmp_path, "sup.py", """
+        def grow(factory, engines, pressure):
+            while pressure():
+                engines.append(factory(len(engines)))  # unicore-lint: disable=UL118
+    """)
+    assert "UL118" not in rules_of(found)
+
+
+def test_ul118_repo_sweep_clean():
+    """Every replica boot in the repo is gated — the autoscaler's
+    envelope (max_replicas + cooldown + boot budget) and the router's
+    breaker-gated canary keep fleet growth bounded."""
+    import os
+
+    root = _repo_root()
+    found = [
+        f for f in lint_paths(
+            [os.path.join(root, "unicore_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "tools")],
+            rel_to=root,
+        )
+        if f.rule == "UL118"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)

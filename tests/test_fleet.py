@@ -21,8 +21,9 @@ import numpy as np
 import pytest
 
 from examples.lm.model import TransformerLMModel
-from unicore_tpu.fleet import (FleetRouter, HashRing, clip_trace,
-                               generate_trace, replay_trace)
+from unicore_tpu.fleet import (SCENARIOS, FleetAutoscaler, FleetRouter,
+                               HashRing, clip_trace, generate_trace,
+                               replay_trace, scenario_trace)
 from unicore_tpu.fleet.health import (CircuitBreaker, ReplicaHealth,
                                       PROGRESS_KEYS)
 from unicore_tpu.fleet.ring import stable_hash
@@ -853,3 +854,228 @@ def test_chaos_fleet_failover_legs():
         with open(out) as f:
             leg = json.load(f)[key]
         assert checks(leg), (flag, leg)
+
+
+# -- traffic-scenario suite (ISSUE 20) -------------------------------------
+
+
+def test_scenario_suite_seeded_determinism():
+    assert SCENARIOS == ("diurnal", "flash_crowd", "heavy_tail",
+                         "session_churn")
+    for name in SCENARIOS:
+        a = scenario_trace(name, 11, num_requests=24, vocab=V)
+        b = scenario_trace(name, 11, num_requests=24, vocab=V)
+        assert trace_fields(a) == trace_fields(b), name
+        c = scenario_trace(name, 12, num_requests=24, vocab=V)
+        assert trace_fields(a) != trace_fields(c), name
+
+
+def test_scenario_traces_merge_ordered_with_unique_ids():
+    for name in SCENARIOS:
+        events = scenario_trace(name, 7, num_requests=24, vocab=V)
+        assert events, name
+        ids = [e.request.request_id for e in events]
+        assert len(set(ids)) == len(ids), name
+        keys = [(e.at_ms, e.request.request_id) for e in events]
+        assert keys == sorted(keys), name
+
+
+def test_scenario_unknown_name_and_duplicate_merge_raise():
+    from unicore_tpu.fleet.trace import merge_traces
+
+    with pytest.raises(ValueError, match="unknown scenario"):
+        scenario_trace("tsunami", 7)
+    base = generate_trace(3, num_requests=4, vocab=V)
+    with pytest.raises(ValueError, match="duplicate request id"):
+        merge_traces(base, base)
+
+
+# -- EWMA step-time smoothing (ISSUE 20 satellite) -------------------------
+
+
+def test_step_ewma_single_spike_no_reroute(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=2, router_kw=dict(service_floor_ms=1.0))
+    home = router.ring.lookup("hot")
+    other = next(r for r in router.engines if r != home)
+    # steady 2ms service folds into the EWMA...
+    for _ in range(6):
+        router._observe_step_ms(home, 2.0)
+    # ...then ONE 100ms hiccup (GC pause, page-cache miss)
+    router._observe_step_ms(home, 100.0)
+    assert router.smoothed_step_ms(home) == pytest.approx(
+        0.75 * 2.0 + 0.25 * 100.0)
+    for i in range(4):
+        assert router.submit(
+            Request(prompt=[1 + i, 2, 3], max_new_tokens=4, seed=i,
+                    request_id=f"f{i}"),
+            session_key="hot") == home
+    # the INSTANTANEOUS projection would reroute (4 deep x 100ms x 1.5
+    # = 600ms >> 200ms deadline); the EWMA's 26.5ms projects 159ms and
+    # keeps affinity — one hiccup must not scatter the session
+    probe = Request(prompt=[5, 6], max_new_tokens=2, seed=9,
+                    request_id="p0", deadline_ms=200.0)
+    assert router.submit(probe, session_key="hot") == home
+    # a SUSTAINED spike is real pressure: the EWMA converges toward it
+    # and the same deadline now deterministically reroutes
+    router._observe_step_ms(home, 100.0)
+    router._observe_step_ms(home, 100.0)
+    probe2 = Request(prompt=[7, 8], max_new_tokens=2, seed=10,
+                     request_id="p1", deadline_ms=200.0)
+    assert router.submit(probe2, session_key="hot") == other
+    assert router.stats["overflow_routed"] == 1
+    router.run_until_complete()
+    assert all(e.pool.is_idle() for e in router.engines.values())
+
+
+def test_step_ewma_skips_unmeasured_steps(lm):
+    router = make_fleet(lm, n=2)
+    # before any observation: the instantaneous snapshot value rules
+    assert router.smoothed_step_ms(
+        "r0", {"step_ms": 7.0}) == pytest.approx(7.0)
+    router._observe_step_ms("r0", 4.0)
+    # zero-width (idle) steps must not drag the estimate toward 0
+    router._observe_step_ms("r0", 0.0)
+    router._observe_step_ms("r0", -1.0)
+    assert router.smoothed_step_ms("r0") == pytest.approx(4.0)
+    # and the floor clamps pathological small estimates
+    router._step_ewma["r1"] = 0.01
+    assert router.smoothed_step_ms("r1") == router.service_floor_ms
+
+
+# -- elastic scaling (ISSUE 20) --------------------------------------------
+
+
+def _engine_factory(lm):
+    model, params = lm
+
+    def factory(rid):
+        del rid
+        return ServeEngine(model, params, **POOL)
+
+    return factory
+
+
+def test_scale_up_boots_through_canary_off_ring(lm):
+    router = make_fleet(lm, n=2,
+                        router_kw=dict(factory=_engine_factory(lm)))
+    assert router.scale_up("a0") is True
+    # OFF-RING while probing: no traffic can route to the canary slot
+    assert "a0" in router._probation and "a0" not in router.engines
+    assert "a0" not in router.ring.members()
+    for _ in range(router.probe_budget_steps + 2):
+        router.step()
+        if "a0" in router.engines:
+            break
+    assert "a0" in router.engines and "a0" in router.ring.members()
+    assert router.stats["scale_ups"] == 1
+    # a joined slot behaves like any other: the id is now taken
+    with pytest.raises(ValueError):
+        router.scale_up("a0")
+    # no factory, no elasticity — loud, not silent
+    with pytest.raises(RuntimeError, match="factory"):
+        make_fleet(lm, n=1).scale_up("a1")
+
+
+def test_retire_replica_zero_drop_under_load(lm):
+    from unicore_tpu.serve.scheduler import Request
+
+    router = make_fleet(lm, n=3)
+    reqs = [Request(prompt=[1 + (i % 7), 2, 3], max_new_tokens=4,
+                    seed=i, request_id=f"q{i}") for i in range(9)]
+    for i, req in enumerate(reqs):
+        router.submit(req, session_key=f"s{i % 4}")
+    router.step()
+    victim = sorted(router.engines)[0]
+    router.retire_replica(victim)
+    assert victim not in router.ring.members()
+    assert victim in router.fleet_report()["retiring"]
+    router.run_until_complete()
+    # every request completed token-identical to a solo run — the
+    # retirement dropped nothing
+    results = router.results()
+    assert len(results) == len(reqs)
+    for req in reqs:
+        res = results[req.request_id]
+        assert res.finish_reason in ("eos", "length"), res
+        assert res.tokens == solo_tokens(lm, req), req.request_id
+    assert victim not in router.engines
+    assert router.stats["retired"] == 1
+    rec = router.fleet_report()["retired"][victim]
+    assert rec["died"] is False and rec["pool_idle"] is True
+    assert rec["drain"] is not None
+    assert rec["drain"]["shed"] == 0 and rec["drain"]["expired"] == 0
+    # the drained engine's pool ended idle and is kept auditable
+    assert router._retired_engines[victim].pool.is_idle()
+
+
+def test_fleet_report_pins_autoscale_and_retirement_keys(lm):
+    router = make_fleet(lm, n=2)
+    rep = router.fleet_report()
+    assert rep["autoscale"] is None
+    assert rep["retiring"] == [] and rep["retired"] == {}
+    router.attach_autoscaler(FleetAutoscaler(router, min_replicas=1,
+                                             max_replicas=3))
+    auto = router.fleet_report()["autoscale"]
+    want = {
+        "min_replicas", "max_replicas", "serving", "booting",
+        "retiring", "scale_ups", "scale_downs", "boot_failures",
+        "boot_budget", "high_watermark_ms", "low_watermark_ms",
+        "last_pressure_ms", "decisions",
+    }
+    assert set(auto) == want, auto
+    assert auto["serving"] == 2 and auto["booting"] == []
+    assert auto["scale_ups"] == 0 and auto["decisions"] == []
+
+
+def test_autoscaler_envelope_validation(lm):
+    router = make_fleet(lm, n=2)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(router, min_replicas=0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(router, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(router, high_watermark_ms=4.0,
+                        low_watermark_ms=4.0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(router, hysteresis_steps=0)
+
+
+def _autoscale_run(lm, trace):
+    router = make_fleet(lm, n=2,
+                        router_kw=dict(factory=_engine_factory(lm)))
+    scaler = router.attach_autoscaler(FleetAutoscaler(
+        router, min_replicas=1, max_replicas=3,
+        high_watermark_ms=12.0, low_watermark_ms=1.0,
+        hysteresis_steps=2, cooldown_steps=4, step_time_ms=2.0))
+    replay_trace(router, trace)
+    router.run_until_complete()
+    return router, scaler
+
+
+def test_autoscaler_decisions_replay_identically(lm):
+    trace = clip_trace(
+        scenario_trace("flash_crowd", 5, num_requests=18, vocab=V,
+                       body_len_clip=(1, 16)),
+        MAX_CONTEXT,
+    )
+    ra, sa = _autoscale_run(lm, trace)
+    rb, sb = _autoscale_run(lm, trace)
+    assert sa.decisions, "the flash crowd should provoke a decision"
+    assert sa.decisions == sb.decisions
+    assert {r: res.tokens for r, res in ra.results().items()} \
+        == {r: res.tokens for r, res in rb.results().items()}
+    assert ra.fleet_report()["autoscale"] == rb.fleet_report()["autoscale"]
+    assert len(ra.results()) == len(trace)
+
+
+def test_serve_cli_autoscale_flag_validation():
+    from unicore_tpu.serve.cli import main
+
+    with pytest.raises(SystemExit, match="needs --fleet"):
+        main(["--demo", "--autoscale", "--num-requests", "2"])
+    with pytest.raises(SystemExit, match="envelope is empty"):
+        main(["--demo", "--fleet", "--autoscale",
+              "--min-replicas", "3", "--max-replicas", "2",
+              "--num-requests", "2"])

@@ -1384,6 +1384,318 @@ def serve_publish_poisoned_leg(args, report):
         )
 
 
+def serve_fleet_flash_crowd_leg(args, report):
+    """``--serve --fleet --flash-crowd`` (ISSUE 20): a background
+    trickle is hit by a sudden crowd of brand-new sessions.  The
+    autoscaler must react within a bounded number of fleet steps —
+    booting replicas OFF-RING through the breaker canary path, never
+    past ``max_replicas`` — every admitted survivor must stay
+    bit-identical to its solo oracle across the scale events, and the
+    whole run (decisions included) must replay bit-identically twice.
+    A second pair of runs pins SATURATION: with zero scale headroom
+    and a bounded queue the fleet sheds deterministically (bounded
+    peak_waiting) instead of growing or collapsing."""
+    import math
+
+    from unicore_tpu.fleet.autoscaler import FleetAutoscaler
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import (clip_trace, replay_trace,
+                                         scenario_trace)
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.engine import ServeEngine
+
+    step_ms = 2.0
+    reaction_budget = 24  # fleet steps: crowd onset -> replica serving
+    model, params = _demo_model(args.seed)
+    trace = clip_trace(
+        scenario_trace("flash_crowd", args.seed, num_requests=36,
+                       vocab=model.vocab_size, body_len_clip=(1, 20)),
+        (SERVE_POOL["num_pages"] - 1) * SERVE_POOL["page_size"],
+    )
+    onset_ms = min(e.at_ms for e in trace
+                   if e.session.startswith("crowd."))
+    onset_step = math.ceil(onset_ms / step_ms)
+    print(f"[chaos] fleet flash-crowd leg: {len(trace)} arrivals, "
+          f"crowd lands ~fleet step {onset_step}; autoscale 2->4 "
+          f"(twice, asserting determinism) then saturated 2-replica "
+          f"runs (twice, asserting bounded deterministic shed)",
+          flush=True)
+
+    def run(max_replicas, max_waiting):
+        def factory(rid):
+            del rid
+            return ServeEngine(model, params, max_waiting=max_waiting,
+                               **SERVE_POOL)
+
+        router = FleetRouter({rid: factory(rid) for rid in ("r0", "r1")},
+                             factory=factory)
+        scaler = router.attach_autoscaler(FleetAutoscaler(
+            router, min_replicas=2, max_replicas=max_replicas,
+            high_watermark_ms=24.0, low_watermark_ms=1.0,
+            hysteresis_steps=2, cooldown_steps=8,
+            step_time_ms=step_ms,
+        ))
+        steps = replay_trace(router, trace, step_ms=step_ms)
+        return (router, scaler,
+                _fleet_outcome(router, model, params, trace), steps)
+
+    # elastic pair: headroom to 4 replicas, unbounded queue
+    ra, sa, oa, steps_a = run(4, None)
+    rb, sb, ob, steps_b = run(4, None)
+    pools = list(ra.engines.values()) + list(
+        ra._retired_engines.values())
+    pools_idle = all(e.pool.is_idle() for e in pools)
+    for eng in pools:
+        eng.pool.check_invariants()
+    joins = [d for d in sa.decisions if d["action"] == "joined"]
+    first_up = next((d for d in sa.decisions
+                     if d["action"] == "scale_up"), None)
+    first_join = joins[0] if joins else None
+    # reaction: crowd onset -> first booted replica SERVING.  May be
+    # negative when a base-trickle burst crossed the watermark before
+    # the crowd's first arrival — early capacity is fine; LATE is the
+    # failure mode the budget bounds.
+    reaction_steps = (None if first_join is None
+                      else first_join["fleet_step"] - onset_step)
+    boot_steps = (None if first_join is None or first_up is None
+                  else first_join["fleet_step"] - first_up["fleet_step"])
+    deterministic = (sa.decisions == sb.decisions
+                     and oa["tokens"] == ob["tokens"]
+                     and oa["reasons"] == ob["reasons"]
+                     and ra.stats == rb.stats and steps_a == steps_b)
+
+    # saturation pair: zero headroom, bounded queues — shed, don't grow
+    max_waiting = 4
+    waiting_bound = max_waiting + SERVE_POOL["max_batch"]
+    rc, sc, oc, _ = run(2, max_waiting)
+    rd, sd, od, _ = run(2, max_waiting)
+    shed_c = sorted(rid for rid, reason in oc["typed"]
+                    if reason == "shed")
+    shed_d = sorted(rid for rid, reason in od["typed"]
+                    if reason == "shed")
+    peak_waiting = max(e.stats["peak_waiting"]
+                       for e in rc.engines.values())
+
+    report["fleet_flash_crowd"] = {
+        "arrivals": len(trace), "crowd_onset_step": onset_step,
+        "scale_ups": sa._scale_ups, "joins": len(joins),
+        "first_scale_up": first_up, "first_join": first_join,
+        "reaction_steps": reaction_steps,
+        "reaction_budget": reaction_budget,
+        "reaction_ms": (None if reaction_steps is None
+                        else reaction_steps * step_ms),
+        "boot_steps": boot_steps,
+        "missing": oa["missing"], "typed": oa["typed"],
+        "bit_exact_survivors": oa["bit_exact_survivors"],
+        "mismatches": oa["mismatches"][:5],
+        "pools_idle": pools_idle,
+        "deterministic_replay": deterministic,
+        "autoscale": ra.fleet_report()["autoscale"],
+        "saturated_scale_ups": sc._scale_ups,
+        "saturated_replicas": len(rc.engines),
+        "saturated_shed": shed_c,
+        "saturated_shed_deterministic": shed_c == shed_d,
+        "saturated_peak_waiting": peak_waiting,
+        "saturated_waiting_bound": waiting_bound,
+        "saturated_exact": not oc["mismatches"],
+    }
+    if sa._scale_ups < 1 or not joins:
+        raise RuntimeError(
+            f"flash-crowd leg: the crowd never triggered a scale-up "
+            f"(scale_ups={sa._scale_ups}, joins={len(joins)})"
+        )
+    if reaction_steps is None or reaction_steps > reaction_budget:
+        raise RuntimeError(
+            f"flash-crowd leg: scale-up reaction {reaction_steps} "
+            f"fleet steps past the budget {reaction_budget}"
+        )
+    if boot_steps is None or boot_steps > ra.probe_budget_steps:
+        raise RuntimeError(
+            f"flash-crowd leg: decision-to-serving took {boot_steps} "
+            f"fleet steps (probe budget {ra.probe_budget_steps})"
+        )
+    if len(ra.engines) > 4:
+        raise RuntimeError(
+            f"flash-crowd leg: fleet grew past max_replicas: "
+            f"{sorted(ra.engines)}"
+        )
+    if oa["missing"] or oa["typed"]:
+        raise RuntimeError(
+            f"flash-crowd leg: admitted requests dropped through the "
+            f"scale events: missing={oa['missing']} typed={oa['typed']}"
+        )
+    if oa["mismatches"]:
+        raise RuntimeError(
+            f"flash-crowd leg: {len(oa['mismatches'])} survivor "
+            f"stream(s) diverged from the solo oracle: "
+            f"{oa['mismatches'][:3]}"
+        )
+    if not pools_idle:
+        raise RuntimeError("flash-crowd leg: pool pages leaked across "
+                           "the scale events")
+    if not deterministic:
+        raise RuntimeError(
+            "flash-crowd leg: the replay was NOT deterministic — "
+            f"decisions {sa.decisions} vs {sb.decisions}"
+        )
+    if sc._scale_ups != 0 or len(rc.engines) != 2:
+        raise RuntimeError(
+            f"flash-crowd leg: the saturated fleet grew anyway "
+            f"(scale_ups={sc._scale_ups}, replicas={len(rc.engines)})"
+        )
+    if not shed_c:
+        raise RuntimeError(
+            "flash-crowd leg: the saturated fleet shed nothing — the "
+            "crowd was not a real overload"
+        )
+    if shed_c != shed_d:
+        raise RuntimeError(
+            f"flash-crowd leg: saturated shed decisions diverged run "
+            f"to run: {shed_c} vs {shed_d}"
+        )
+    if peak_waiting > waiting_bound:
+        raise RuntimeError(
+            f"flash-crowd leg: saturated waiting queue grew to "
+            f"{peak_waiting} past the bound {waiting_bound}"
+        )
+    if oc["missing"] or oc["mismatches"]:
+        raise RuntimeError(
+            f"flash-crowd leg: saturated run dropped or diverged: "
+            f"missing={oc['missing']} mismatches={oc['mismatches'][:3]}"
+        )
+
+
+def serve_fleet_scale_down_leg(args, report):
+    """``--serve --fleet --scale-down`` (ISSUE 20): a diurnal trace —
+    quiet, peak, quiet — over a 3-replica fleet with autoscaling.  The
+    lulls must RETIRE capacity through the zero-drop drain while
+    arrivals keep landing: zero admitted requests may fail, expire, or
+    shed; every retired replica's pool must end idle and
+    invariant-clean; the serving floor (``min_replicas``) holds; and
+    the whole run replays bit-identically twice."""
+    from unicore_tpu.fleet.autoscaler import FleetAutoscaler
+    from unicore_tpu.fleet.router import FleetRouter
+    from unicore_tpu.fleet.trace import (clip_trace, replay_trace,
+                                         scenario_trace)
+    from unicore_tpu.serve.cli import _demo_model
+    from unicore_tpu.serve.engine import ServeEngine
+
+    step_ms = 2.0
+    min_replicas = 1
+    model, params = _demo_model(args.seed)
+    trace = clip_trace(
+        scenario_trace("diurnal", args.seed, num_requests=32,
+                       vocab=model.vocab_size, body_len_clip=(1, 20)),
+        (SERVE_POOL["num_pages"] - 1) * SERVE_POOL["page_size"],
+    )
+    last_arrival_ms = max(e.at_ms for e in trace)
+    print(f"[chaos] fleet scale-down leg: {len(trace)} diurnal "
+          f"arrivals into 3 replicas, autoscale floor "
+          f"{min_replicas} (twice, asserting determinism)", flush=True)
+
+    def run():
+        def factory(rid):
+            del rid
+            return ServeEngine(model, params, **SERVE_POOL)
+
+        router = FleetRouter(
+            {rid: factory(rid) for rid in ("r0", "r1", "r2")},
+            factory=factory,
+        )
+        scaler = router.attach_autoscaler(FleetAutoscaler(
+            router, min_replicas=min_replicas, max_replicas=3,
+            high_watermark_ms=500.0, low_watermark_ms=5.0,
+            hysteresis_steps=3, cooldown_steps=6,
+            step_time_ms=step_ms,
+        ))
+        steps = replay_trace(router, trace, step_ms=step_ms)
+        return (router, scaler,
+                _fleet_outcome(router, model, params, trace), steps)
+
+    ra, sa, oa, steps_a = run()
+    rb, sb, ob, steps_b = run()
+    retired = ra.fleet_report()["retired"]
+    downs = [d for d in sa.decisions if d["action"] == "scale_down"]
+    retired_pools_idle = all(
+        e.pool.is_idle() for e in ra._retired_engines.values())
+    for eng in list(ra.engines.values()) + list(
+            ra._retired_engines.values()):
+        eng.pool.check_invariants()
+    # "under live load": arrivals were still landing after the first
+    # retirement fired
+    first_down_ms = (downs[0]["fleet_step"] * step_ms
+                     if downs else None)
+    live = first_down_ms is not None and first_down_ms < last_arrival_ms
+    deterministic = (sa.decisions == sb.decisions
+                     and oa["tokens"] == ob["tokens"]
+                     and oa["reasons"] == ob["reasons"]
+                     and ra.stats == rb.stats and steps_a == steps_b)
+
+    report["fleet_scale_down"] = {
+        "arrivals": len(trace),
+        "scale_downs": sa._scale_downs,
+        "retired": retired,
+        "first_scale_down": downs[0] if downs else None,
+        "last_arrival_ms": last_arrival_ms,
+        "retired_under_live_load": live,
+        "serving_floor": min_replicas,
+        "serving_end": len(ra.engines),
+        "missing": oa["missing"], "typed": oa["typed"],
+        "bit_exact_survivors": oa["bit_exact_survivors"],
+        "mismatches": oa["mismatches"][:5],
+        "retired_pools_idle": retired_pools_idle,
+        "rerouted": ra.stats["rerouted"],
+        "deterministic_replay": deterministic,
+        "autoscale": ra.fleet_report()["autoscale"],
+    }
+    if sa._scale_downs < 1 or not retired:
+        raise RuntimeError(
+            f"scale-down leg: the lull never retired a replica "
+            f"(scale_downs={sa._scale_downs})"
+        )
+    if not live:
+        raise RuntimeError(
+            f"scale-down leg: the first retirement (step "
+            f"{downs[0]['fleet_step'] if downs else None}) fired after "
+            f"the last arrival ({last_arrival_ms} ms) — the drain was "
+            f"not under live load"
+        )
+    if oa["missing"] or oa["typed"]:
+        raise RuntimeError(
+            f"scale-down leg: admitted requests failed/expired/shed "
+            f"through the retirement: missing={oa['missing']} "
+            f"typed={oa['typed']}"
+        )
+    if oa["mismatches"]:
+        raise RuntimeError(
+            f"scale-down leg: {len(oa['mismatches'])} survivor "
+            f"stream(s) diverged: {oa['mismatches'][:3]}"
+        )
+    for rid, rec in sorted(retired.items()):
+        if rec["died"] or not rec["pool_idle"] or rec["drain"] is None:
+            raise RuntimeError(
+                f"scale-down leg: replica {rid!r} retirement was not a "
+                f"clean zero-drop drain: {rec}"
+            )
+        if rec["drain"]["shed"] or rec["drain"]["expired"]:
+            raise RuntimeError(
+                f"scale-down leg: replica {rid!r} drain shed/expired "
+                f"work: {rec['drain']}"
+            )
+    if not retired_pools_idle:
+        raise RuntimeError("scale-down leg: retired pool pages leaked")
+    if len(ra.engines) < min_replicas:
+        raise RuntimeError(
+            f"scale-down leg: serving replicas {sorted(ra.engines)} "
+            f"fell below the floor {min_replicas}"
+        )
+    if not deterministic:
+        raise RuntimeError(
+            "scale-down leg: the replay was NOT deterministic — "
+            f"decisions {sa.decisions} vs {sb.decisions}"
+        )
+
+
 def serve_main(args):
     import tempfile
 
@@ -1415,12 +1727,15 @@ def serve_main(args):
             ("flap", args.flap),
             ("publish-mid-flood", args.publish_mid_flood),
             ("publish-poisoned", args.publish_poisoned),
+            ("flash-crowd", args.flash_crowd),
+            ("scale-down", args.scale_down),
         ) if on]
         if not wanted:
             raise SystemExit(
                 "--serve --fleet needs at least one of --rolling, "
                 "--kill-replica, --wedge-replica, --flap, "
-                "--publish-mid-flood, --publish-poisoned"
+                "--publish-mid-flood, --publish-poisoned, "
+                "--flash-crowd, --scale-down"
             )
         if args.rolling:
             serve_fleet_rolling_leg(args, report)
@@ -1440,6 +1755,12 @@ def serve_main(args):
         if args.publish_poisoned:
             serve_publish_poisoned_leg(args, report)
             legs.append("fleet-publish-poisoned")
+        if args.flash_crowd:
+            serve_fleet_flash_crowd_leg(args, report)
+            legs.append("fleet-flash-crowd")
+        if args.scale_down:
+            serve_fleet_scale_down_leg(args, report)
+            legs.append("fleet-scale-down")
     if not legs:
         raise SystemExit(
             "--serve needs at least one of --inject poison:K, --flood, "
@@ -1866,6 +2187,22 @@ def build_parser():
                         "manifest publishes against live traffic: both "
                         "must trip the deploy breaker on the canary, "
                         "roll back, and never reach a second replica")
+    p.add_argument("--flash-crowd", action="store_true",
+                   help="(with --serve --fleet) elastic scale-up "
+                        "(ISSUE 20): a sudden crowd of new sessions "
+                        "hits a 2-replica autoscaled fleet; the policy "
+                        "must boot replicas off-ring within a bounded "
+                        "reaction, survivors stay solo-oracle-exact, "
+                        "the replay is run-twice deterministic, and a "
+                        "saturated (max_replicas) variant sheds "
+                        "deterministically instead of growing")
+    p.add_argument("--scale-down", action="store_true",
+                   help="(with --serve --fleet) elastic scale-down "
+                        "(ISSUE 20): diurnal lulls must retire "
+                        "replicas through the zero-drop drain under "
+                        "live load — zero failed/expired/shed admitted "
+                        "requests, retired pools idle, min_replicas "
+                        "floor held, run-twice deterministic")
     p.add_argument("--kills", type=int, default=1,
                    help="how many kill+resume cycles before the final "
                         "run to completion")

@@ -45,7 +45,8 @@ and Pass 4 gated overlap:
 - UL403 nondeterministic-planning: an AST pass over the host planning
   modules that feed device programs (scheduler row planning,
   ``comm_bucket_assignment``, kv_pool chain matching, fleet
-  ring/routing, rollout gates — ``PLANNING_MODULES``).  Flagged:
+  ring/routing, autoscale decisions, rollout gates —
+  ``PLANNING_MODULES``).  Flagged:
 
   * iteration over a ``set``/``frozenset`` without ``sorted()`` — set
     order is salted per process, so two replicas derive different
@@ -143,6 +144,7 @@ PLANNING_MODULES: Tuple[str, ...] = (
     os.path.join("unicore_tpu", "fleet", "ring.py"),
     os.path.join("unicore_tpu", "fleet", "router.py"),
     os.path.join("unicore_tpu", "fleet", "health.py"),
+    os.path.join("unicore_tpu", "fleet", "autoscaler.py"),
     os.path.join("unicore_tpu", "deploy", "rollout.py"),
 )
 

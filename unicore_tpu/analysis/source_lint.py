@@ -170,6 +170,27 @@ Rules (see docs/static_analysis.md for rationale and incidents):
   references (``clock or time.perf_counter``) are defaults for the
   injectable idiom itself and are not calls, so they never fire.
 
+- UL118 unbounded-replica-growth: a replica-factory boot — a
+  ``*factory*(...)`` call — inside a ``for``/``while`` loop whose
+  result GROWS the fleet (``.append``/``.add``/``.insert`` onto a
+  collection, or a subscript store whose key is not the loop variable,
+  or any store in a ``while`` loop) with no scale gate anywhere in the
+  loop: no max-replicas bound (a comparison involving a ``*max*``
+  name or a ``len()`` call), no ``*cooldown*`` gate, and no breaker
+  ``.ready()`` check.
+  This is UL109's fleet-tier sibling, but each unbounded "queue entry"
+  here is a whole ServeEngine — params + KV pool + compiled step — so
+  a retry/pressure loop that boots replicas without a bound turns one
+  overload or one flapping replica into host OOM and a boot storm
+  against the checkpoint store.  The sanctioned path is the
+  autoscaler envelope: ``serving + booting < max_replicas``, a
+  per-direction cooldown, and a bounded boot budget
+  (``fleet/autoscaler.py``), with each boot routed through the
+  breaker-gated canary (``FleetRouter.scale_up``).  The rolling
+  restart's REPLACEMENT shape — ``engines[rid] = factory(rid)`` keyed
+  by the loop variable — swaps slots without growing the fleet and
+  never fires.
+
 Suppression: append ``# unicore-lint: disable=UL104`` (comma-separated
 ids, or ``all``) to the flagged line.
 """
@@ -318,7 +339,10 @@ _UL117_TIMING_NAME_RE = re.compile(
 # UL117: basename fragments that mark a module as decision dispatch
 # (fleet/ and deploy/ are in scope wholesale — see _is_decision_file)
 _UL117_DECISION_FRAGS = ("scheduler", "engine", "router", "rollout",
-                         "health", "tuner", "tuning")
+                         "health", "tuner", "tuning", "autoscaler")
+
+# UL118: method tails that grow a collection with the factory's result
+_UL118_GROW_TAILS = {"append", "appendleft", "add", "insert"}
 
 
 def _attr_chain(node):
@@ -358,6 +382,7 @@ class _ModuleLint(ast.NodeVisitor):
         self._serve_loop_depth = 0
         self._router_loop_depth = 0
         self._ul113_depth = 0
+        self._ul118_depth = 0
         self._tree = ast.parse(source, filename=path)
         self._collect_imports_and_jit_targets()
         self._collect_zero1_plumbing()
@@ -1092,6 +1117,140 @@ class _ModuleLint(ast.NodeVisitor):
 
         walk(loop, False)
 
+    @staticmethod
+    def _ul118_factory_call(node):
+        """A call whose callee's final name contains ``factory`` — the
+        boot path of a fleet slot.  Returns a display name or None."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        else:
+            return None
+        if "factory" not in name.lower():
+            return None
+        return _attr_chain(func) or name
+
+    def _loop_has_factory_call(self, loop):
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if self._ul118_factory_call(sub) is not None:
+                return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _check_unbounded_replica_growth(self, loop):
+        """UL118 over one outermost factory-calling loop: find every
+        store that GROWS the fleet with a factory result — an
+        ``.append``/``.add``/``.insert`` of it, or a subscript store
+        keyed by anything but a loop variable (in a ``while`` loop
+        there IS no loop variable, so every store counts) — then
+        silence them all if the loop carries a scale gate anywhere: a
+        comparison involving a ``*max*`` name or a ``len()`` bound, a
+        ``*cooldown*`` gate, or a breaker ``.ready()`` check.  The replacement shape
+        ``engines[rid] = factory(rid)`` keyed by the loop variable
+        (rolling restart) swaps a slot without growing the fleet and
+        is exempt.  Closures defined in the loop are fresh scopes, as
+        everywhere in this linter."""
+        loop_vars = set()
+        factory_names = set()  # names bound from a factory call
+        grow_calls = []        # (.append/.add/.insert node, recv, args)
+        sub_stores = []        # (Assign node, Subscript target)
+        has_gate = False
+        stack = [loop]
+        while stack:
+            sub = stack.pop()
+            if sub is not loop and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+                continue
+            frag = None
+            if isinstance(sub, ast.Name):
+                frag = sub.id
+            elif isinstance(sub, ast.Attribute):
+                frag = sub.attr
+            if frag and "cooldown" in frag.lower():
+                has_gate = True
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(sub.target):
+                    if isinstance(n, ast.Name):
+                        loop_vars.add(n.id)
+            elif isinstance(sub, ast.Compare):
+                for n in ast.walk(sub):
+                    nm = (n.id if isinstance(n, ast.Name)
+                          else n.attr if isinstance(n, ast.Attribute)
+                          else None)
+                    if nm and "max" in nm.lower():
+                        has_gate = True
+                    # comparing a len() anywhere bounds the growth
+                    # (``while len(fleet) < cap``), same as UL109
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Name)
+                            and n.func.id == "len"):
+                        has_gate = True
+            elif isinstance(sub, ast.Call):
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "ready"):
+                    has_gate = True
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _UL118_GROW_TAILS):
+                    recv = _attr_chain(sub.func.value)
+                    if recv:
+                        grow_calls.append((sub, recv))
+            elif isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        sub_stores.append((sub, tgt))
+                    elif (isinstance(tgt, ast.Name)
+                          and any(self._ul118_factory_call(n) is not None
+                                  for n in ast.walk(sub.value))):
+                        factory_names.add(tgt.id)
+            stack.extend(ast.iter_child_nodes(sub))
+        if has_gate:
+            return
+
+        def from_factory(value):
+            # the value subtree boots a replica — a direct factory
+            # call, or a name bound from one in this loop
+            for n in ast.walk(value):
+                if self._ul118_factory_call(n) is not None:
+                    return True
+                if isinstance(n, ast.Name) and n.id in factory_names:
+                    return True
+            return False
+
+        growth = [(node, recv) for node, recv in grow_calls
+                  if any(from_factory(a) for a in node.args)]
+        for node, tgt in sub_stores:
+            if not from_factory(node.value):
+                continue
+            key = tgt.slice
+            if isinstance(key, ast.Name) and key.id in loop_vars:
+                continue  # replacement, not growth: rolling restart
+            growth.append((node, _attr_chain(tgt.value) or "<fleet>"))
+        for node, recv in growth:
+            self.emit(
+                "UL118", "unbounded-replica-growth", "error", node,
+                f"replica factory boot grows '{recv}' inside a fleet "
+                f"loop with no max-replicas bound, cooldown gate, or "
+                f"breaker .ready() check in sight — each entry is a "
+                f"whole ServeEngine (params + KV pool + compiled "
+                f"step), so a pressure/retry loop boots replicas "
+                f"until the host OOMs and the checkpoint store takes "
+                f"a boot storm; gate boots on the autoscale envelope "
+                f"(serving + booting < max_replicas, per-direction "
+                f"cooldown, bounded boot budget — fleet/autoscaler.py "
+                f"FleetAutoscaler) and route them through the "
+                f"breaker-gated canary (FleetRouter.scale_up)",
+            )
+
     def _check_blocking_in_router_loop(self, node):
         """UL111: a blocking host call inside a router dispatch loop
         serializes the whole fleet behind one replica."""
@@ -1150,6 +1309,14 @@ class _ModuleLint(ast.NodeVisitor):
             is_replica_loop = True
         else:
             is_replica_loop = False
+        if self._ul118_depth == 0 and self._loop_has_factory_call(node):
+            # scan once from the OUTERMOST factory-calling loop: its
+            # subtree carries the growth sites and the scale gates alike
+            self._check_unbounded_replica_growth(node)
+            self._ul118_depth += 1
+            is_factory_loop = True
+        else:
+            is_factory_loop = False
         if is_step:
             if self._step_loop_depth == 0:
                 # scan once from the OUTERMOST step loop (UL109 pattern):
@@ -1168,6 +1335,8 @@ class _ModuleLint(ast.NodeVisitor):
             self._serve_loop_depth -= 1
         if is_replica_loop:
             self._ul113_depth -= 1
+        if is_factory_loop:
+            self._ul118_depth -= 1
 
     def visit_For(self, node):
         self._visit_loop(node)
@@ -1183,11 +1352,13 @@ class _ModuleLint(ast.NodeVisitor):
         saved_serve, self._serve_loop_depth = self._serve_loop_depth, 0
         saved_router, self._router_loop_depth = self._router_loop_depth, 0
         saved_ul113, self._ul113_depth = self._ul113_depth, 0
+        saved_ul118, self._ul118_depth = self._ul118_depth, 0
         self.generic_visit(node)
         self._step_loop_depth = saved
         self._serve_loop_depth = saved_serve
         self._router_loop_depth = saved_router
         self._ul113_depth = saved_ul113
+        self._ul118_depth = saved_ul118
 
     def visit_FunctionDef(self, node):
         self._visit_scope_reset(node)

@@ -16,6 +16,12 @@ _EXPORTS = {
     "generate_trace": ("unicore_tpu.fleet.trace", "generate_trace"),
     "replay_trace": ("unicore_tpu.fleet.trace", "replay_trace"),
     "clip_trace": ("unicore_tpu.fleet.trace", "clip_trace"),
+    "scenario_trace": ("unicore_tpu.fleet.trace", "scenario_trace"),
+    "merge_traces": ("unicore_tpu.fleet.trace", "merge_traces"),
+    "retag_sessions": ("unicore_tpu.fleet.trace", "retag_sessions"),
+    "SCENARIOS": ("unicore_tpu.fleet.trace", "SCENARIOS"),
+    "FleetAutoscaler": ("unicore_tpu.fleet.autoscaler",
+                        "FleetAutoscaler"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -230,6 +230,23 @@ class CircuitBreaker:
         self.trips.append(int(step))
         self._last_trip = int(step)
 
+    def arm(self, step):
+        """Arm a FRESH slot for an immediate half-open probe (fleet
+        scale-up, ISSUE 20): ``open`` with the cooldown already served
+        and NO trip recorded — booting extra capacity is not a failure,
+        so the flap window stays empty and a later genuine trip starts
+        a clean history.  Only legal on a never-tripped breaker: the
+        rejoin path for a slot that has actually failed must serve its
+        cooldown."""
+        if self.state != CLOSED or self.trips:
+            raise RuntimeError(
+                f"CircuitBreaker.arm() on a used slot (state "
+                f"{self.state!r}, {len(self.trips)} trip(s)) — scale-up "
+                "may only arm a fresh breaker"
+            )
+        self.state = OPEN
+        self._last_trip = int(step) - self.cooldown_steps
+
     def fail(self, step):
         """The half-open canary failed: back to ``open`` (a fresh trip
         — the flap counter sees every failed rejoin)."""

@@ -56,6 +56,21 @@ a fleet:
   ``--kill-replica`` / ``--wedge-replica`` / ``--flap`` legs replay
   bit-identically).
 
+- **Elasticity** (ISSUE 20).  The fleet can GROW and SHRINK at
+  runtime: :meth:`scale_up` boots a brand-new replica slot OFF-RING
+  through the same breaker+canary probe a failover replacement uses
+  (an armed-but-never-tripped breaker, so a scale-up boot is not a
+  "failure" in the flap window), and :meth:`retire_replica` retires a
+  replica through the same zero-drop drain a rolling restart uses —
+  but NON-BLOCKING: the victim leaves the ring, its waiting requests
+  reroute, and its running work finishes over the following fleet
+  steps while everyone else keeps serving; :meth:`step` finalizes the
+  retirement once the victim is idle (pool verified idle, drain
+  report kept).  The decisions themselves live in
+  :class:`~unicore_tpu.fleet.autoscaler.FleetAutoscaler`, attached
+  via :meth:`attach_autoscaler` and polled once per fleet step at the
+  same step boundary the deploy controller uses.
+
 The router is single-threaded and cooperative: :meth:`step` advances
 every replica by one ``serve_step`` (never the batch-blocking
 ``generate()`` — lint rule UL111 polices that shape, and UL113 polices
@@ -87,6 +102,12 @@ MAX_STATS = ("peak_waiting", "peak_pool_occupancy")
 DEFAULT_MAX_FAILOVERS = 2
 DEFAULT_PROBE_BUDGET_STEPS = 32
 
+# EWMA weight for the per-replica smoothed step time: ~0.25 means one
+# outlier decode moves the estimate a quarter of the way, and four
+# normal steps pull it back — a single slow step can no longer flap an
+# SLO-overflow or autoscale decision (ISSUE 20 satellite)
+DEFAULT_STEP_EWMA_ALPHA = 0.25
+
 
 class FleetRouter:
     """Route requests over ``engines`` ({replica_id: ServeEngine}).
@@ -114,6 +135,7 @@ class FleetRouter:
                  factory=None, max_failovers=DEFAULT_MAX_FAILOVERS,
                  health=None, breaker=None,
                  probe_budget_steps=DEFAULT_PROBE_BUDGET_STEPS,
+                 step_ewma_alpha=DEFAULT_STEP_EWMA_ALPHA,
                  clock=None):
         if not engines:
             raise ValueError("a fleet needs at least one replica")
@@ -141,13 +163,21 @@ class FleetRouter:
         self._breakers = {}       # rid -> CircuitBreaker (tripped slots)
         self._probation = {}      # rid -> half-open canary probe state
         self._lost = {}           # rid -> eviction record (most recent)
+        self.step_ewma_alpha = float(step_ewma_alpha)
+        self._step_ewma = {}      # rid -> smoothed step_ms (EWMA)
+        self._retiring = {}       # rid -> in-flight scale-down record
+        self._retired = {}        # rid -> completed retirement record
+        self._retired_engines = {}  # rid -> retired engine (idle, audit)
+        self._managed = set()     # slots whose retry the autoscaler owns
         self.stats = {
             "routed": 0, "overflow_routed": 0, "rerouted": 0,
             "restarts": 0, "failovers": 0, "replica_lost": 0,
-            "replicas_lost": 0, "rejoins": 0,
+            "replicas_lost": 0, "rejoins": 0, "scale_ups": 0,
+            "retired": 0,
         }
         self._auto_id = 0
         self._deploy = None  # RolloutController hook (ISSUE 18)
+        self._autoscaler = None  # FleetAutoscaler hook (ISSUE 20)
 
     def attach_deploy(self, controller):
         """Wire a deploy :class:`~unicore_tpu.deploy.rollout.
@@ -158,6 +188,15 @@ class FleetRouter:
         watermark."""
         self._deploy = controller
         return controller
+
+    def attach_autoscaler(self, scaler):
+        """Wire a :class:`~unicore_tpu.fleet.autoscaler.FleetAutoscaler`
+        into the router: polled once per fleet step at the step
+        boundary (after retirements finalize, before the deploy hook),
+        its :meth:`describe` rides out through
+        ``fleet_report()["autoscale"]``."""
+        self._autoscaler = scaler
+        return scaler
 
     def _make_child(self, rid):
         if self.shutdown is not None:
@@ -221,7 +260,7 @@ class FleetRouter:
             alt = self._least_loaded(healthy, snaps)
             if alt != home:
                 return alt, "shed-overflow"
-        if self._would_blow_deadline(request, snaps[home]):
+        if self._would_blow_deadline(request, snaps[home], home):
             alt = self._least_loaded(healthy, snaps)
             if (alt != home
                     and self._load_key(snaps[alt], alt)
@@ -250,10 +289,35 @@ class FleetRouter:
             return False
         return snap["waiting"] >= snap["max_waiting"] + snap["free_slots"]
 
-    def _would_blow_deadline(self, request, snap):
+    def _observe_step_ms(self, rid, raw_ms):
+        """Fold one measured step time into the replica's EWMA.  Zero
+        samples (no decode yet) are skipped so the floor seeds the
+        estimate instead of a meaningless 0."""
+        if raw_ms <= 0.0:
+            return
+        prev = self._step_ewma.get(rid)
+        if prev is None:
+            self._step_ewma[rid] = float(raw_ms)
+        else:
+            a = self.step_ewma_alpha
+            self._step_ewma[rid] = a * float(raw_ms) + (1.0 - a) * prev
+
+    def smoothed_step_ms(self, rid, snap=None):
+        """The replica's EWMA-smoothed step time (ms), floored at
+        ``service_floor_ms``.  Falls back to the instantaneous
+        ``snap["step_ms"]`` sample only before the first observation —
+        one slow step cannot flap an SLO-overflow or autoscale
+        decision (ISSUE 20 satellite; the autoscaler shares this
+        signal)."""
+        ms = self._step_ewma.get(rid)
+        if ms is None:
+            ms = snap["step_ms"] if snap is not None else 0.0
+        return max(ms, self.service_floor_ms)
+
+    def _would_blow_deadline(self, request, snap, rid):
         if request.deadline_ms is None:
             return False
-        step_ms = max(snap["step_ms"], self.service_floor_ms)
+        step_ms = self.smoothed_step_ms(rid, snap)
         depth = snap["waiting"] + snap["running"]
         projected_ms = depth * step_ms * self.deadline_safety
         return projected_ms > request.deadline_ms
@@ -278,15 +342,21 @@ class FleetRouter:
                 busy = True
         if self._step_probation():
             busy = True
+        self._finalize_retirements()
         self._tick_breakers()
+        if self._autoscaler is not None:
+            # same step boundary as the deploy hook: every replica has
+            # stepped, retirements just finalized — the gauges the
+            # policy reads describe a settled fleet
+            self._autoscaler.on_step(self._fleet_step)
         if self._deploy is not None:
             # the STEP BOUNDARY: every replica has stepped, nothing is
             # mid-dispatch — the only point where a weight swap is legal
             self._deploy.on_step(self._fleet_step)
         # a probe launched by the tick above has not stepped yet: keep
-        # the drive loop alive until its canary settles; an active
-        # rollout likewise holds the drive loop open
-        return (busy or bool(self._probation)
+        # the drive loop alive until its canary settles; an in-flight
+        # retirement or active rollout likewise holds the loop open
+        return (busy or bool(self._probation) or bool(self._retiring)
                 or (self._deploy is not None and self._deploy.active()))
 
     def _step_replica(self, rid):
@@ -310,8 +380,10 @@ class FleetRouter:
             # onto a replica that already stepped THIS fleet step, so
             # the drive loop must come around again or it strands them
             return True
+        snap = eng.load_snapshot()
+        self._observe_step_ms(rid, snap["step_ms"])
         state = self.health.observe(
-            rid, eng.load_snapshot(), eng.has_work(),
+            rid, snap, eng.has_work(),
             step=self._fleet_step,
         )
         if state == DEAD:
@@ -372,6 +444,19 @@ class FleetRouter:
         eng = self.engines.pop(rid)
         reason = self.health.reason(rid) or "dead"
         self.ring.discard(rid)
+        self._step_ewma.pop(rid, None)
+        if rid in self._retiring:
+            # the victim died MID-RETIRE: the fleet already decided it
+            # does not need this capacity, so the slot must NOT
+            # auto-probe a replacement — record the retirement as died
+            # and leave any retry to the autoscaler
+            rec = self._retiring.pop(rid)
+            self._managed.add(rid)
+            self._retired[rid] = {
+                "fleet_step": self._fleet_step, "since": rec["since"],
+                "rerouted": rec["rerouted"], "drain": None,
+                "pool_idle": False, "died": True,
+            }
         child = self._children.pop(rid, None)
         if child is not None:
             child.mark_lost()
@@ -481,6 +566,11 @@ class FleetRouter:
         for rid in sorted(self._breakers):
             if rid in self.engines or rid in self._probation:
                 continue
+            if rid in self._managed:
+                # an autoscaler-owned slot: whether (and when) to retry
+                # the boot is the policy's call, bounded by its boot
+                # budget — the router must not retry behind its back
+                continue
             if self._breakers[rid].ready(self._fleet_step):
                 self._start_probation(rid)
 
@@ -568,12 +658,133 @@ class FleetRouter:
         self.engines[rid] = eng
         self.ring.add(rid)
         self.health.reset(rid)
+        self._step_ewma.pop(rid, None)  # fresh engine, fresh estimate
         self._breakers[rid].succeed(self._fleet_step)
+        was_scale_up = rid in self._managed
+        self._managed.discard(rid)  # a full member retries like any slot
+        if was_scale_up:
+            self.stats["scale_ups"] += 1
         self.stats["rejoins"] += 1
         logger.warning(
             "replica %r REJOINED the ring at fleet step %d (canary "
             "completed; breaker closed)", rid, self._fleet_step,
         )
+
+    # -- elasticity (ISSUE 20) -------------------------------------------
+
+    def scale_up(self, rid):
+        """Boot a brand-new replica slot OFF-RING through the breaker's
+        canary probe path (ISSUE 20): the slot gets an armed-but-never-
+        tripped breaker (:meth:`~unicore_tpu.fleet.health.
+        CircuitBreaker.arm` — immediately probe-ready, empty flap
+        window), ``factory(rid)`` boots off the ring, and only a
+        completed canary joins it (:meth:`_rejoin`).  A replica that
+        fails its canary NEVER takes traffic; whether to retry is the
+        autoscaler's call (the slot is marked managed, so
+        :meth:`_tick_breakers` will not retry behind its back).
+        Returns True while the boot is in flight (canary pending),
+        False if the factory failed outright."""
+        if self.factory is None:
+            raise RuntimeError("scale_up needs a replacement factory")
+        if (rid in self.engines or rid in self._probation
+                or rid in self._retiring):
+            raise ValueError(f"replica id {rid!r} already in use")
+        breaker = self._breakers.get(rid)
+        if breaker is None:
+            breaker = self._breakers[rid] = self._breaker_factory(rid)
+            breaker.arm(self._fleet_step)
+        elif not breaker.ready(self._fleet_step):
+            raise RuntimeError(
+                f"scale_up({rid!r}): slot breaker not ready (state "
+                f"{breaker.state!r}) — a failed boot must serve its "
+                "cooldown before a retry"
+            )
+        self._managed.add(rid)
+        self._retired.pop(rid, None)
+        self._start_probation(rid)
+        return rid in self._probation
+
+    def retire_replica(self, rid, *, signum=_signal.SIGTERM):
+        """Begin retiring replica ``rid`` (scale-down) through the SAME
+        zero-drop drain path a rolling restart uses, but NON-BLOCKING:
+        leave the ring (its sessions remap minimally), request drain
+        through its ChildShutdown, reroute its reclaimed waiting
+        requests (they hold no pool pages), and return — the victim's
+        running work finishes over the following fleet steps while the
+        rest of the fleet keeps serving, and :meth:`step` finalizes
+        the retirement once the victim is idle."""
+        if rid not in self.engines:
+            raise ValueError(f"no live replica {rid!r} to retire")
+        if rid in self._retiring:
+            raise ValueError(f"replica {rid!r} is already retiring")
+        eng = self.engines[rid]
+        self.ring.remove(rid)
+        # drain FIRST: the victim's snapshot reports draining=True, so
+        # the reroute below can never route back onto it
+        self._children[rid].request(signum)
+        rerouted = eng.reclaim_waiting()
+        for req in rerouted:
+            self._replica_of.pop(req.request_id, None)
+            sess = self._session_of.pop(req.request_id, None)
+            self.submit(req, session_key=sess)
+            self.stats["rerouted"] += 1
+        self._retiring[rid] = {
+            "since": self._fleet_step, "rerouted": len(rerouted),
+        }
+        logger.warning(
+            "replica %r RETIRING at fleet step %d: off the ring, %d "
+            "waiting request(s) rerouted, running work draining",
+            rid, self._fleet_step, len(rerouted),
+        )
+
+    def _finalize_retirements(self):
+        """Complete any in-flight scale-down whose victim has gone
+        idle: finalize its drain report, verify the pool ends idle
+        (pages leaked across a retirement would be invisible forever),
+        harvest its last results, and remove the replica.  A victim
+        that died mid-drain was already recorded by
+        :meth:`_evict_replica` (failover salvaged its queues)."""
+        for rid in sorted(self._retiring):
+            eng = self.engines.get(rid)
+            if eng is None:
+                continue  # died mid-retire; eviction recorded it
+            if eng.has_work():
+                continue
+            self._step_replica(rid)  # idle call finalizes the drain report
+            if rid not in self.engines:
+                continue  # declared dead on its very last step
+            rep = eng.drain_report
+            if rep is None:
+                # idle when the drain landed: synthesize the zero report
+                # (same shape), so every retirement records its drain
+                rep = self._zero_drain_report(eng)
+            for res in eng.collect_finished():
+                self._settle_result(res)
+            if not eng.pool.is_idle():
+                raise RuntimeError(
+                    f"replica {rid!r} retired but its pool is not idle "
+                    "— pages leaked across the scale-down"
+                )
+            eng.pool.check_invariants()
+            rec = self._retiring.pop(rid)
+            del self.engines[rid]
+            self._step_ewma.pop(rid, None)
+            child = self._children.pop(rid, None)
+            if child is not None:
+                child.mark_retired()
+            self.health.reset(rid)
+            self._retired_engines[rid] = eng
+            self.stats["retired"] += 1
+            self._retired[rid] = {
+                "fleet_step": self._fleet_step, "since": rec["since"],
+                "rerouted": rec["rerouted"], "drain": rep,
+                "pool_idle": True, "died": False,
+            }
+            logger.warning(
+                "replica %r RETIRED at fleet step %d (drained in %d "
+                "fleet step(s), pool idle)", rid, self._fleet_step,
+                self._fleet_step - rec["since"],
+            )
 
     # -- rolling restart ------------------------------------------------
 
@@ -668,17 +879,23 @@ class FleetRouter:
                 continue
             rep = eng.drain_report
             if rep is None:
-                signame = None
-                if eng.shutdown is not None and eng.shutdown.signum:
-                    signame = _signal.Signals(eng.shutdown.signum).name
-                rep = {
-                    "requested": True, "signal": signame, "drain_ms": 0.0,
-                    "drain_timeout_s": eng.drain_timeout,
-                    "shed": 0, "expired": 0, "deadline_exceeded": False,
-                    "pool_idle": eng.pool.is_idle(),
-                }
+                rep = self._zero_drain_report(eng)
             reports[rid] = rep
         return reports
+
+    @staticmethod
+    def _zero_drain_report(eng):
+        """Drain-report shape for a replica that was already idle when
+        the drain landed — same keys as a mid-stream drain's report."""
+        signame = None
+        if eng.shutdown is not None and eng.shutdown.signum:
+            signame = _signal.Signals(eng.shutdown.signum).name
+        return {
+            "requested": True, "signal": signame, "drain_ms": 0.0,
+            "drain_timeout_s": eng.drain_timeout,
+            "shed": 0, "expired": 0, "deadline_exceeded": False,
+            "pool_idle": eng.pool.is_idle(),
+        }
 
     # -- aggregate report ----------------------------------------------
 
@@ -722,6 +939,11 @@ class FleetRouter:
             "breakers": {str(rid): br.describe()
                          for rid, br in sorted(self._breakers.items())},
             "probation": sorted(map(str, self._probation)),
+            "retiring": sorted(map(str, self._retiring)),
+            "retired": {str(rid): dict(rec)
+                        for rid, rec in sorted(self._retired.items())},
             "deploy": (None if self._deploy is None
                        else self._deploy.describe()),
+            "autoscale": (None if self._autoscaler is None
+                          else self._autoscaler.describe()),
         }

@@ -113,6 +113,25 @@ def make_parser():
                           "flap window hold the replica slot "
                           "quarantined — a flapping replica cannot "
                           "thrash the ring (default: 3)")
+    flt.add_argument("--autoscale", action="store_true",
+                     help="fleet mode: attach the deterministic "
+                          "elastic scaling policy (docs/serving.md"
+                          "#autoscaling) — scale-up boots replicas "
+                          "off-ring through the breaker canary path, "
+                          "scale-down retires the least-loaded "
+                          "replica via the zero-drop drain")
+    flt.add_argument("--min-replicas", type=int, default=1,
+                     help="autoscale: never retire below this many "
+                          "serving replicas (default: 1)")
+    flt.add_argument("--max-replicas", type=int, default=4,
+                     help="autoscale: never boot above this many "
+                          "serving+booting replicas — at saturation "
+                          "the engines shed deterministically instead "
+                          "of growing (default: 4)")
+    flt.add_argument("--scale-cooldown-steps", type=int, default=16,
+                     help="autoscale: per-direction refractory period "
+                          "between scaling decisions, in fleet steps "
+                          "(default: 16)")
     flt.add_argument("--publish-dir", default=None,
                      help="deploy: watch this directory for published "
                           "weight manifests and roll them out live via "
@@ -277,6 +296,17 @@ def _fleet_main(args, model, params, requests, shutdown):
             flap_limit=args.flap_limit,
         ),
     )
+    if args.autoscale:
+        from unicore_tpu.fleet.autoscaler import FleetAutoscaler
+
+        # the policy attaches itself via the router hook; its
+        # describe() rides out through fleet_report()["autoscale"]
+        router.attach_autoscaler(FleetAutoscaler(
+            router,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            cooldown_steps=args.scale_cooldown_steps,
+        ))
     if args.publish_dir:
         from unicore_tpu.deploy import DeploySubscriber, RolloutController
 
@@ -300,8 +330,13 @@ def _fleet_main(args, model, params, requests, shutdown):
     # proves the pools end idle exactly like the solo path's report
     drains = router.drain()
     results = router.results()
-    pool_clean = all(e.pool.is_idle() for e in engines.values())
-    for eng in engines.values():
+    # audit every pool the run ever touched: the originals, anything
+    # the autoscaler booted (still serving), and anything it retired
+    audited = dict(engines)
+    audited.update(router.engines)
+    audited.update(router._retired_engines)
+    pool_clean = all(e.pool.is_idle() for e in audited.values())
+    for eng in audited.values():
         eng.pool.check_invariants()
     report = {
         "results": [_result_record(results[r.request_id])
@@ -341,6 +376,17 @@ def main(argv=None):
     args = make_parser().parse_args(argv)
     if not args.demo and not args.checkpoint:
         raise SystemExit("need --checkpoint (with --dict) or --demo")
+    # fail fast on an impossible autoscale envelope — a policy that
+    # could neither boot nor retire must die at the parser, not
+    # mid-flood (ISSUE 20 satellite)
+    if args.autoscale and not args.fleet:
+        raise SystemExit("--autoscale needs --fleet (the scaling "
+                         "policy steps with the fleet router)")
+    if args.min_replicas > args.max_replicas:
+        raise SystemExit(
+            f"--min-replicas {args.min_replicas} > --max-replicas "
+            f"{args.max_replicas}: the autoscale envelope is empty"
+        )
 
     from unicore_tpu.serve.engine import ServeEngine
 
